@@ -1,0 +1,75 @@
+"""Model configuration: constants + finite bounds for the tensor codec.
+
+The reference pins its two fault-injection constants in
+/root/reference/KubeAPI.toolbox/Model_1/MC.tla:4-11 (both TRUE) and binds them
+via MC.cfg:2-8.  The state space is finite because every domain in the spec is
+finite; this module records those bounds so the codec can allocate fixed-width
+slots (SURVEY.md §7 "hard parts": bounds must be config-driven with overflow
+detection).
+
+Scaled configs (BASELINE.json: N controllers x M objects) grow `identities`
+and `clients`; everything downstream (codec widths, kernel lane counts) is
+derived from this object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Bounds and constants for one model-checking run."""
+
+    # Fault-injection constants (KubeAPI.tla:4-9; MC.tla:4-11)
+    requests_can_fail: bool = True
+    requests_can_timeout: bool = True
+
+    # Object identities (kind, name) that can ever exist in apiState.
+    # Model_1 only ever writes Secret/"foo" (KubeAPI.tla:176) and PVC/"mypvc"
+    # (KubeAPI.tla:182).
+    identities: Tuple[Tuple[str, str], ...] = (("Secret", "foo"), ("PVC", "mypvc"))
+
+    # Client processes (issue API/ListAPI calls; ProcSet minus the server,
+    # KubeAPI.tla:453).  Order fixes the vv bit assignment and the request
+    # slot order.
+    clients: Tuple[str, ...] = ("Client", "PVCController")
+
+    @property
+    def kinds(self) -> Tuple[str, ...]:
+        seen = []
+        for k, _ in self.identities:
+            if k not in seen:
+                seen.append(k)
+        return tuple(seen)
+
+    @property
+    def n_identities(self) -> int:
+        return len(self.identities)
+
+    @property
+    def n_clients(self) -> int:
+        return len(self.clients)
+
+    @property
+    def max_per_kind(self) -> int:
+        """Max number of identities sharing one kind == list-result bound."""
+        return max(sum(1 for k, _ in self.identities if k == kk) for kk in self.kinds)
+
+    def identity_id(self, kind: str, name: str) -> int:
+        return self.identities.index((kind, name))
+
+
+# The configuration checked by the committed reference run
+# (/root/reference/KubeAPI.toolbox/Model_1/MC.out).
+MODEL_1 = ModelConfig(requests_can_fail=True, requests_can_timeout=True)
+
+# The fault-injection smoke-test matrix (SURVEY.md §4 item 3): turning the
+# constants off shrinks the state space - the natural fast-CI corners.
+MATRIX = {
+    (False, False): ModelConfig(False, False),
+    (False, True): ModelConfig(False, True),
+    (True, False): ModelConfig(True, False),
+    (True, True): MODEL_1,
+}
