@@ -1,0 +1,300 @@
+"""Fully device-resident BFS model-checking engine.
+
+The TLC BFS core replacement (tlc2.tool.Worker + DiskStateQueue +
+OffHeapDiskFPSet, /root/reference/KubeAPI.toolbox/Model_1/MC.out:5): one
+``lax.while_loop`` whose body pops a fixed-size chunk from a device-resident
+ring-buffer frontier, expands it through the vmapped next-state kernel,
+evaluates invariants, fingerprints + dedups against the device hash table,
+and appends the new states - no host round-trips until the state space is
+exhausted or a violation is found.
+
+Level-synchronous by construction: a chunk never crosses a BFS level
+boundary (`level_end` fences the FIFO), so reported depth is the exact BFS
+level count, matching TLC's "depth of the complete state graph search"
+(MC.out:1101), and in-batch fingerprint arbitration never has to choose
+between states of different levels.
+
+Violation handling: the fused loop carries a violation code + the offending
+encoded state; on violation the CLI re-runs in the host driver
+(engine.hostdriver) which keeps parent pointers and reconstructs the
+counterexample trace (TLC trace-explorer analog, SURVEY.md §2.3 E11).
+
+Counters are maintained per action label (generated + distinct), feeding the
+TLC-style coverage report (E9) in io/tlc_log.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import ModelConfig
+from ..spec.codec import get_codec
+from ..spec.invariants import make_invariant_kernel
+from ..spec.kernel import initial_vectors, make_kernel
+from ..spec.labels import LABELS
+from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED, fp64_words
+from .fpset import FPSet, fpset_insert, fpset_new
+
+# violation codes
+OK = 0
+VIOL_TYPEOK = 1
+VIOL_ONLYONEVERSION = 2
+VIOL_ASSERT = 3
+VIOL_DEADLOCK = 4
+VIOL_SLOT_OVERFLOW = 5
+VIOL_FPSET_FULL = 6
+VIOL_QUEUE_FULL = 7
+
+VIOLATION_NAMES = {
+    OK: "none",
+    VIOL_TYPEOK: "Invariant TypeOK is violated",
+    VIOL_ONLYONEVERSION: "Invariant OnlyOneVersion is violated",
+    VIOL_ASSERT: "Assertion failure (PlusCal assert)",
+    VIOL_DEADLOCK: "Deadlock reached",
+    VIOL_SLOT_OVERFLOW: "Codec slot overflow (raise ModelConfig bounds)",
+    VIOL_FPSET_FULL: "Fingerprint table full (raise fp_capacity)",
+    VIOL_QUEUE_FULL: "Frontier queue full (raise queue_capacity)",
+}
+
+
+class EngineCarry(NamedTuple):
+    fps: FPSet
+    queue: jnp.ndarray  # [qcap + 1, F] (last row = scatter dump)
+    qhead: jnp.ndarray  # int32
+    qtail: jnp.ndarray  # int32
+    level_end: jnp.ndarray  # int32: queue index fencing the current level
+    level: jnp.ndarray  # int32: BFS level of states being popped (init = 1)
+    depth: jnp.ndarray  # int32: deepest nonempty level
+    generated: jnp.ndarray  # uint32
+    distinct: jnp.ndarray  # uint32
+    act_gen: jnp.ndarray  # [n_labels + 1] uint32
+    act_dist: jnp.ndarray  # [n_labels + 1] uint32
+    viol: jnp.ndarray  # int32 code
+    viol_state: jnp.ndarray  # [F] int32
+    viol_action: jnp.ndarray  # int32
+
+
+class CheckResult(NamedTuple):
+    generated: int
+    distinct: int
+    depth: int
+    queue_left: int
+    violation: int
+    violation_name: str
+    violation_state: np.ndarray
+    violation_action: int
+    action_generated: dict
+    action_distinct: dict
+    wall_s: float
+    iterations: int
+
+
+def make_engine(
+    cfg: ModelConfig,
+    chunk: int = 1024,
+    queue_capacity: int = 1 << 15,
+    fp_capacity: int = 1 << 20,
+    fp_index: int = DEFAULT_FP_INDEX,
+    seed: int = DEFAULT_SEED,
+):
+    """Build (init_fn, run_fn, step_fn) for one configuration.
+
+    init_fn() -> EngineCarry seeded with the Init states.
+    run_fn(carry) -> EngineCarry after exhaustion/violation (jitted, fused).
+    step_fn(carry) -> EngineCarry after ONE chunk (jitted; for checkpointed
+    / incremental runs).
+    """
+    cdc = get_codec(cfg)
+    F = cdc.n_fields
+    step = make_kernel(cfg)
+    L = step.n_lanes
+    inv_check = make_invariant_kernel(cfg)
+    n_labels = len(LABELS)
+    nbits = cdc.nbits
+    qcap = queue_capacity
+
+    def init_fn() -> EngineCarry:
+        inits = jnp.asarray(initial_vectors(cfg))
+        n0 = inits.shape[0]
+        queue = jnp.zeros((qcap + 1, F), jnp.int32).at[:n0].set(inits)
+        packed = cdc.pack(inits)
+        lo, hi = fp64_words(packed, nbits, fp_index, seed)
+        fps, is_new = fpset_insert(
+            fpset_new(fp_capacity), lo, hi, jnp.ones(n0, bool)
+        )
+        distinct0 = is_new.sum().astype(jnp.uint32)
+        return EngineCarry(
+            fps=fps,
+            queue=queue,
+            qhead=jnp.int32(0),
+            qtail=jnp.int32(n0),
+            level_end=jnp.int32(n0),
+            level=jnp.int32(1),
+            depth=jnp.int32(1),
+            generated=jnp.uint32(n0),
+            distinct=distinct0,
+            act_gen=jnp.zeros(n_labels + 1, jnp.uint32),
+            act_dist=jnp.zeros(n_labels + 1, jnp.uint32),
+            viol=jnp.int32(OK),
+            viol_state=jnp.zeros(F, jnp.int32),
+            viol_action=jnp.int32(-1),
+        )
+
+    def body(c: EngineCarry) -> EngineCarry:
+        avail = jnp.minimum(c.level_end, c.qtail) - c.qhead
+        n = jnp.minimum(chunk, avail)
+        rows = jnp.arange(chunk, dtype=jnp.int32)
+        mask = rows < n
+        idx = (c.qhead + rows) % qcap
+        batch = c.queue[idx]
+
+        succs, valid, action, afail, ovf = jax.vmap(step)(batch)
+        valid = valid & mask[:, None]
+        afail = afail & valid
+        ovf = ovf & valid
+        dead = mask & ~valid.any(axis=1)
+
+        flat = succs.reshape(chunk * L, F)
+        fvalid = valid.reshape(-1)
+        faction = action.reshape(-1)
+
+        inv = jax.vmap(inv_check)(flat)
+        bad_type = fvalid & ((inv & 1) == 0)
+        bad_oov = fvalid & ((inv & 2) == 0)
+
+        packed = cdc.pack(flat)
+        lo, hi = fp64_words(packed, nbits, fp_index, seed)
+
+        fp_full = (c.distinct.astype(jnp.int32) + chunk * L) > int(
+            fp_capacity * 0.85
+        )
+        insert_mask = fvalid & ~fp_full
+        fps, is_new = fpset_insert(c.fps, lo, hi, insert_mask)
+
+        n_new = is_new.sum().astype(jnp.int32)
+        q_full = (c.qtail - c.qhead) + n_new > qcap
+
+        # append new states (prefix-sum positions; dump row for non-new)
+        pos = c.qtail + jnp.cumsum(is_new.astype(jnp.int32)) - 1
+        tgt = jnp.where(is_new & ~q_full, pos % qcap, qcap)
+        queue = c.queue.at[tgt].set(flat)
+
+        # counters
+        generated = c.generated + valid.sum().astype(jnp.uint32)
+        distinct = c.distinct + n_new.astype(jnp.uint32)
+        act_gen = c.act_gen.at[jnp.where(fvalid, faction, n_labels)].add(1)
+        act_dist = c.act_dist.at[jnp.where(is_new, faction, n_labels)].add(1)
+
+        # violations (first wins; priority: invariant > assert > deadlock >
+        # capacity).  Capture the offending state: candidate for invariants,
+        # source state for assert/deadlock.
+        def first_state(mask_flat, states):
+            i = jnp.argmax(mask_flat)
+            return states[i]
+
+        viol = c.viol
+        viol_state = c.viol_state
+        viol_action = c.viol_action
+
+        for code, vmask, states, acts in (
+            (VIOL_TYPEOK, bad_type, flat, faction),
+            (VIOL_ONLYONEVERSION, bad_oov, flat, faction),
+            (VIOL_ASSERT, afail.reshape(-1), jnp.repeat(batch, L, axis=0), faction),
+            (VIOL_DEADLOCK, dead, batch, jnp.full(chunk, -1, jnp.int32)),
+            (VIOL_SLOT_OVERFLOW, ovf.reshape(-1), jnp.repeat(batch, L, axis=0), faction),
+        ):
+            hit = vmask.any() & (viol == OK)
+            viol = jnp.where(hit, code, viol)
+            viol_state = jnp.where(hit, first_state(vmask, states), viol_state)
+            viol_action = jnp.where(
+                hit, acts[jnp.argmax(vmask)].astype(jnp.int32), viol_action
+            )
+        hit = fp_full & fvalid.any() & (viol == OK)
+        viol = jnp.where(hit, VIOL_FPSET_FULL, viol)
+        hit = q_full & (viol == OK)
+        viol = jnp.where(hit, VIOL_QUEUE_FULL, viol)
+
+        # advance FIFO + level bookkeeping
+        qhead = c.qhead + n
+        qtail = jnp.where(q_full, c.qtail, c.qtail + n_new)
+        level_done = qhead == c.level_end
+        more = qtail > qhead
+        level = jnp.where(level_done & more, c.level + 1, c.level)
+        depth = jnp.maximum(c.depth, jnp.where(more, level, c.level))
+        level_end = jnp.where(level_done, qtail, c.level_end)
+
+        return EngineCarry(
+            fps=fps,
+            queue=queue,
+            qhead=qhead,
+            qtail=qtail,
+            level_end=level_end,
+            level=level,
+            depth=depth,
+            generated=generated,
+            distinct=distinct,
+            act_gen=act_gen,
+            act_dist=act_dist,
+            viol=viol,
+            viol_state=viol_state,
+            viol_action=viol_action,
+        )
+
+    def cond(c: EngineCarry):
+        return (c.qtail > c.qhead) & (c.viol == OK)
+
+    @jax.jit
+    def run_fn(c: EngineCarry) -> EngineCarry:
+        return lax.while_loop(cond, body, c)
+
+    @jax.jit
+    def step_fn(c: EngineCarry) -> EngineCarry:
+        return lax.cond(cond(c), body, lambda x: x, c)
+
+    return init_fn, run_fn, step_fn
+
+
+def check(
+    cfg: ModelConfig,
+    chunk: int = 1024,
+    queue_capacity: int = 1 << 15,
+    fp_capacity: int = 1 << 20,
+    fp_index: int = DEFAULT_FP_INDEX,
+    seed: int = DEFAULT_SEED,
+) -> CheckResult:
+    """Run an exhaustive check; the single-device engine entry point."""
+    init_fn, run_fn, _ = make_engine(
+        cfg, chunk, queue_capacity, fp_capacity, fp_index, seed
+    )
+    t0 = time.time()
+    carry = init_fn()
+    carry = run_fn(carry)
+    carry = jax.block_until_ready(carry)
+    wall = time.time() - t0
+    act_gen = np.asarray(carry.act_gen)[: len(LABELS)]
+    act_dist = np.asarray(carry.act_dist)[: len(LABELS)]
+    return CheckResult(
+        generated=int(carry.generated),
+        distinct=int(carry.distinct),
+        depth=int(carry.depth),
+        queue_left=int(carry.qtail - carry.qhead),
+        violation=int(carry.viol),
+        violation_name=VIOLATION_NAMES[int(carry.viol)],
+        violation_state=np.asarray(carry.viol_state),
+        violation_action=int(carry.viol_action),
+        action_generated={
+            LABELS[i]: int(v) for i, v in enumerate(act_gen) if v
+        },
+        action_distinct={
+            LABELS[i]: int(v) for i, v in enumerate(act_dist) if v
+        },
+        wall_s=wall,
+        iterations=-1,
+    )

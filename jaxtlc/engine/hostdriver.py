@@ -1,0 +1,133 @@
+"""Host-looped BFS driver over the vmapped kernel.
+
+The debugging/trace-mode driver (and the differential-test harness): the BFS
+loop runs in Python, dedup in a host dict, successor expansion on device via
+the vmapped kernel with *fixed-size padded chunks* (one compilation total -
+XLA requires static shapes, so frontiers are processed in CHUNK-sized slabs
+padded with a sentinel mask; see SURVEY.md §7 hard parts "dynamic frontier
+sizes vs static shapes").
+
+The fully device-resident driver (lax.while_loop + device fingerprint set)
+lives in jaxtlc.engine.bfs; this host driver is its oracle-adjacent sibling
+that retains per-state parent pointers for counterexample reconstruction
+(TLC trace-explorer analog, SURVEY.md §2.3 E11).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..spec.codec import get_codec
+from ..spec.kernel import batched_kernel, initial_vectors
+
+
+class HostBFSResult(NamedTuple):
+    generated: int
+    distinct: int
+    depth: int
+    max_outdegree: int
+    min_outdegree: int
+    violations: List[Tuple[str, tuple]]  # (kind, encoded state tuple)
+    levels: List[int]
+    action_generated: Dict[int, int]  # action label id -> generated count
+    parents: Dict[tuple, Tuple[Optional[tuple], int]]  # child -> (parent, action)
+
+
+def host_bfs(
+    cfg: ModelConfig,
+    chunk: int = 512,
+    on_level: Optional[Callable] = None,
+    keep_parents: bool = False,
+    stop_on_violation: bool = True,
+) -> HostBFSResult:
+    cdc = get_codec(cfg)
+    kern = batched_kernel(cfg)
+    F = cdc.n_fields
+
+    inits = initial_vectors(cfg)
+    seen: Dict[tuple, int] = {}
+    parents: Dict[tuple, Tuple[Optional[tuple], int]] = {}
+    frontier: List[np.ndarray] = []
+    generated = 0
+    violations: List[Tuple[str, tuple]] = []
+    for s in inits:
+        generated += 1
+        t = tuple(map(int, s))
+        if t not in seen:
+            seen[t] = 1
+            parents[t] = (None, -1)
+            frontier.append(np.asarray(s, np.int32))
+    depth = 1
+    levels = [len(frontier)]
+    max_out, min_out = 0, 1 << 30
+    action_generated: Dict[int, int] = {}
+
+    pad_template = np.zeros((chunk, F), dtype=np.int32)
+
+    while frontier:
+        if on_level is not None:
+            on_level(depth, frontier)
+        nxt: List[np.ndarray] = []
+        for base in range(0, len(frontier), chunk):
+            batch = frontier[base : base + chunk]
+            n = len(batch)
+            buf = pad_template.copy()
+            buf[:n] = np.stack(batch)
+            succs, valid, action, afail, ovf = kern(jnp.asarray(buf))
+            succs = np.asarray(succs)
+            valid = np.array(valid)
+            valid[n:] = False
+            action = np.asarray(action)
+            afail = np.asarray(afail) & valid
+            ovf = np.asarray(ovf) & valid
+            if ovf.any():
+                b = int(np.argwhere(ovf)[0][0])
+                raise RuntimeError(
+                    f"codec slot overflow expanding state "
+                    f"{cdc.decode(buf[b])!r} - raise ModelConfig bounds"
+                )
+            generated += int(valid.sum())
+            for b in range(n):
+                outdeg = 0
+                src_t = tuple(map(int, buf[b]))
+                succ_set = set()
+                for l in range(succs.shape[1]):
+                    if not valid[b, l]:
+                        continue
+                    aid = int(action[b, l])
+                    action_generated[aid] = action_generated.get(aid, 0) + 1
+                    t = tuple(map(int, succs[b, l]))
+                    succ_set.add(t)
+                    if afail[b, l]:
+                        violations.append((f"assert@action{aid}", src_t))
+                    if t not in seen:
+                        seen[t] = depth + 1
+                        nxt.append(succs[b, l])
+                        if keep_parents:
+                            parents[t] = (src_t, aid)
+                outdeg = len(succ_set)
+                max_out = max(max_out, outdeg)
+                min_out = min(min_out, outdeg)
+                if outdeg == 0:
+                    violations.append(("deadlock", src_t))
+        if violations and stop_on_violation:
+            break
+        frontier = nxt
+        if frontier:
+            depth += 1
+            levels.append(len(frontier))
+    return HostBFSResult(
+        generated,
+        len(seen),
+        depth,
+        max_out,
+        min_out,
+        violations,
+        levels,
+        action_generated,
+        parents,
+    )

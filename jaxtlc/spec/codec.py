@@ -1,0 +1,415 @@
+"""Fixed-width tensor codec for KubeAPI states.
+
+Encodes the full variable vector (vars, /root/reference/KubeAPI.tla:450-451)
+as a flat vector of F int32 *fields* - the working representation of the
+vmapped kernel - plus a bit-packer that compresses a field vector to W uint32
+words for fingerprinting (the canonical wire form).
+
+Design points (SURVEY.md §7 item 1 and "hard parts"):
+
+* **Set-valued state with partial domains**: API objects may lack vv/spec
+  (DOMAIN tests at KubeAPI.tla:29-31, 94-95).  Every object is one int32 word
+  of presence-bit-guarded fields; `apiState` and each list result are arrays
+  of such words kept in *canonical descending order* so TLA set equality ==
+  array equality and fingerprints are permutation-invariant.
+* **Bounds are config-driven**: slot counts derive from ModelConfig
+  (identities, clients, max_per_kind); scaled-constant configs change only
+  the config.  Slot overflow is detected by the kernel, not silently dropped.
+* **No native int64**: the packed form is uint32 words; the 64-bit
+  fingerprint is computed from them in 2-lane form (engine.fingerprint).
+
+Object word layout (LSB..MSB):
+    [has_spec:1][vv:NC][has_vv:1][ident:IB][present:1]
+`present` is the most-significant used bit so that plain descending sort of
+words puts present objects first - the canonical order.  A present object
+with `spec` always satisfies spec == [pvname |-> name] (the only spec value
+the spec ever constructs, KubeAPI.tla:675-678); encode() asserts this.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from . import oracle
+from .labels import (
+    DEFAULT_INIT,
+    LABELS,
+    LABEL_ID,
+    PROC_API,
+    PROC_LISTAPI,
+    PROCESSES,
+    RESPONSES,
+    RESPONSE_ID,
+    VERBS,
+    VERB_ID,
+)
+
+
+def _bits_for(n: int) -> int:
+    return max(1, (n - 1).bit_length())
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    count: int  # number of int32 lanes
+    width: int  # bits per lane when packed
+
+
+class Codec:
+    """Field layout + encode/decode/pack for one ModelConfig."""
+
+    def __init__(self, cfg: ModelConfig):
+        self.cfg = cfg
+        ni, nc = cfg.n_identities, cfg.n_clients
+        ls = cfg.max_per_kind
+        self.ni, self.nc, self.ls = ni, nc, ls
+        self.ib = _bits_for(ni)
+        self.kb = _bits_for(len(cfg.kinds))
+        self.lb = _bits_for(len(LABELS))
+        # object word layout
+        self.o_spec = 0
+        self.o_vv = 1
+        self.o_hasvv = 1 + nc
+        self.o_ident = 2 + nc
+        self.o_present = 2 + nc + self.ib
+        self.obj_bits = self.o_present + 1
+        # request word layout: [status:2][op:3][present:1] above the obj word
+        self.r_obj = 0
+        self.r_status = self.obj_bits
+        self.r_op = self.obj_bits + 2
+        self.r_present = self.obj_bits + 5
+        self.req_bits = self.r_present + 1
+        # list-request meta word: [status:2][kind:kb][present:1]
+        self.lm_status = 0
+        self.lm_kind = 2
+        self.lm_present = 2 + self.kb
+        self.lm_bits = self.lm_present + 1
+        # stack word: [retpc:lb][proc:1][present:1]
+        self.s_retpc = 0
+        self.s_proc = self.lb
+        self.s_present = self.lb + 1
+        self.stk_bits = self.s_present + 1
+
+        self.fields: List[Field] = [
+            Field("api", ni, self.obj_bits),
+            Field("req", nc, self.req_bits),
+            Field("lreq_meta", nc, self.lm_bits),
+            Field("lreq_obj", nc * ls, self.obj_bits),
+            Field("pc", len(PROCESSES), self.lb),
+            Field("stack", nc, self.stk_bits),
+            Field("p_op", nc, 3),  # 0 = defaultInitValue, else 1 + verb id
+            Field("p_obj", nc, self.obj_bits),  # 0 = dIV (present bit clear)
+            Field("p_kind", nc, self.kb + 1),  # 0 = dIV, else 1 + kind id
+            Field("sr", 1, 1),
+        ]
+        self.offsets: Dict[str, int] = {}
+        off = 0
+        for f in self.fields:
+            self.offsets[f.name] = off
+            off += f.count
+        self.n_fields = off
+        self.nbits = sum(f.count * f.width for f in self.fields)
+        self.n_words = (self.nbits + 31) // 32
+        self.kind_id = {k: i for i, k in enumerate(cfg.kinds)}
+        self.client_id = {c: i for i, c in enumerate(cfg.clients)}
+
+    # -- slicing helpers ----------------------------------------------------
+
+    def sl(self, name: str):
+        off = self.offsets[name]
+        cnt = next(f.count for f in self.fields if f.name == name)
+        return slice(off, off + cnt)
+
+    # -- object word (host) -------------------------------------------------
+
+    def encode_obj(self, o) -> int:
+        """Oracle object record -> object word."""
+        kind, name = oracle.fld(o, "k"), oracle.fld(o, "n")
+        ident = self.cfg.identity_id(kind, name)
+        w = (1 << self.o_present) | (ident << self.o_ident)
+        vv = oracle.fld(o, "vv")
+        if vv is not None or oracle.has(o, "vv"):
+            w |= 1 << self.o_hasvv
+            for c in vv:
+                w |= 1 << (self.o_vv + self.client_id[c])
+        if oracle.has(o, "spec"):
+            spec = oracle.fld(o, "spec")
+            assert spec == oracle.rec(pvname=name), (
+                "codec invariant: spec is always [pvname |-> own name] "
+                f"(KubeAPI.tla:675-678); got {spec!r}"
+            )
+            w |= 1 << self.o_spec
+        assert not oracle.has(o, "status"), "objects never carry status"
+        return w
+
+    def decode_obj(self, w: int):
+        """Object word -> oracle object record (None if absent)."""
+        if not (w >> self.o_present) & 1:
+            return None
+        ident = (w >> self.o_ident) & ((1 << self.ib) - 1)
+        kind, name = self.cfg.identities[ident]
+        d = {"k": kind, "n": name}
+        if (w >> self.o_hasvv) & 1:
+            d["vv"] = frozenset(
+                self.cfg.clients[i]
+                for i in range(self.nc)
+                if (w >> (self.o_vv + i)) & 1
+            )
+        if (w >> self.o_spec) & 1:
+            d["spec"] = oracle.rec(pvname=name)
+        return tuple(sorted(d.items()))
+
+    # -- full state (host) --------------------------------------------------
+
+    def encode(self, st: oracle.State) -> np.ndarray:
+        """Oracle state -> canonical field vector (np.int32[F])."""
+        v = np.zeros(self.n_fields, dtype=np.int64)
+        # apiState: canonical descending order
+        words = sorted((self.encode_obj(o) for o in st.api_state), reverse=True)
+        assert len(words) <= self.ni, "apiState slot overflow"
+        v[self.sl("api")][: len(words)] = words
+        # requests
+        req = v[self.sl("req")]
+        for c, r in st.requests:
+            ci = self.client_id[c]
+            w = (1 << self.r_present)
+            w |= VERB_ID[oracle.fld(r, "op")] << self.r_op
+            w |= RESPONSE_ID[oracle.fld(r, "status")] << self.r_status
+            w |= self.encode_obj(oracle.fld(r, "obj")) << self.r_obj
+            req[ci] = w
+        # listRequests
+        lm = v[self.sl("lreq_meta")]
+        lo = v[self.sl("lreq_obj")]
+        for c, r in st.list_requests:
+            ci = self.client_id[c]
+            w = (1 << self.lm_present)
+            w |= self.kind_id[oracle.fld(r, "kind")] << self.lm_kind
+            w |= RESPONSE_ID[oracle.fld(r, "status")] << self.lm_status
+            lm[ci] = w
+            objs = sorted(
+                (self.encode_obj(o) for o in oracle.fld(r, "objs")), reverse=True
+            )
+            assert len(objs) <= self.ls, "list slot overflow"
+            lo[ci * self.ls : ci * self.ls + len(objs)] = objs
+        # pc
+        v[self.sl("pc")] = [LABEL_ID[l] for l in st.pc]
+        # stack (client processes only; server never calls, KubeAPI.tla:698)
+        stk = v[self.sl("stack")]
+        assert not st.stack[2], "server stack is always empty"
+        for ci in range(self.nc):
+            frames = st.stack[ci]
+            assert len(frames) <= 1, "procedures never nest (SURVEY.md §7)"
+            if frames:
+                f = frames[0]
+                w = 1 << self.s_present
+                if oracle.fld(f, "procedure") == PROC_LISTAPI:
+                    w |= 1 << self.s_proc
+                    assert oracle.fld(f, "kind") == DEFAULT_INIT, (
+                        "frames always save defaultInitValue params"
+                    )
+                else:
+                    assert oracle.fld(f, "op") == DEFAULT_INIT
+                    assert oracle.fld(f, "obj") == DEFAULT_INIT
+                w |= LABEL_ID[oracle.fld(f, "pc")] << self.s_retpc
+                stk[ci] = w
+        # procedure params (client processes; server's stay defaultInitValue)
+        for name, enc in (
+            ("p_op", lambda x: 0 if x == DEFAULT_INIT else 1 + VERB_ID[x]),
+            ("p_obj", lambda x: 0 if x == DEFAULT_INIT else self.encode_obj(x)),
+            ("p_kind", lambda x: 0 if x == DEFAULT_INIT else 1 + self.kind_id[x]),
+        ):
+            src = {"p_op": st.op, "p_obj": st.obj, "p_kind": st.kind}[name]
+            assert src[2] == DEFAULT_INIT, "server params never assigned"
+            arr = v[self.sl(name)]
+            for ci in range(self.nc):
+                arr[ci] = enc(src[ci])
+        v[self.offsets["sr"]] = int(st.should_reconcile)
+        return v.astype(np.int32)
+
+    def decode(self, vec) -> oracle.State:
+        """Field vector -> oracle state (inverse of encode on canonical vecs)."""
+        v = np.asarray(vec, dtype=np.int64)
+        api = frozenset(
+            o
+            for o in (self.decode_obj(int(w)) for w in v[self.sl("api")])
+            if o is not None
+        )
+        requests = ()
+        for ci, w in enumerate(v[self.sl("req")]):
+            w = int(w)
+            if not (w >> self.r_present) & 1:
+                continue
+            r = oracle.rec(
+                op=VERBS[(w >> self.r_op) & 7],
+                obj=self.decode_obj((w >> self.r_obj) & ((1 << self.obj_bits) - 1)),
+                status=RESPONSES[(w >> self.r_status) & 3],
+            )
+            requests = oracle.pmap_set(requests, self.cfg.clients[ci], r)
+        list_requests = ()
+        lo = v[self.sl("lreq_obj")]
+        for ci, w in enumerate(v[self.sl("lreq_meta")]):
+            w = int(w)
+            if not (w >> self.lm_present) & 1:
+                continue
+            objs = frozenset(
+                o
+                for o in (
+                    self.decode_obj(int(x))
+                    for x in lo[ci * self.ls : (ci + 1) * self.ls]
+                )
+                if o is not None
+            )
+            r = oracle.rec(
+                kind=self.cfg.kinds[(w >> self.lm_kind) & ((1 << self.kb) - 1)],
+                objs=objs,
+                status=RESPONSES[(w >> self.lm_status) & 3],
+            )
+            list_requests = oracle.pmap_set(list_requests, self.cfg.clients[ci], r)
+        pc = tuple(LABELS[int(x)] for x in v[self.sl("pc")])
+        stack: List[tuple] = []
+        for ci in range(self.nc):
+            w = int(v[self.sl("stack")][ci])
+            if (w >> self.s_present) & 1:
+                ret = LABELS[(w >> self.s_retpc) & ((1 << self.lb) - 1)]
+                if (w >> self.s_proc) & 1:
+                    frame = oracle.rec(
+                        procedure=PROC_LISTAPI, pc=ret, kind=DEFAULT_INIT
+                    )
+                else:
+                    frame = oracle.rec(
+                        procedure=PROC_API, pc=ret, op=DEFAULT_INIT, obj=DEFAULT_INIT
+                    )
+                stack.append((frame,))
+            else:
+                stack.append(())
+        stack.append(())  # server
+        p_op, p_obj, p_kind = [], [], []
+        for ci in range(self.nc):
+            w = int(v[self.sl("p_op")][ci])
+            p_op.append(DEFAULT_INIT if w == 0 else VERBS[w - 1])
+            w = int(v[self.sl("p_obj")][ci])
+            o = self.decode_obj(w)
+            p_obj.append(DEFAULT_INIT if o is None else o)
+            w = int(v[self.sl("p_kind")][ci])
+            p_kind.append(DEFAULT_INIT if w == 0 else self.cfg.kinds[w - 1])
+        for lst in (p_op, p_obj, p_kind):
+            lst.append(DEFAULT_INIT)
+        return oracle.State(
+            api_state=api,
+            requests=requests,
+            list_requests=list_requests,
+            pc=pc,
+            stack=tuple(stack),
+            op=tuple(p_op),
+            obj=tuple(p_obj),
+            kind=tuple(p_kind),
+            should_reconcile=bool(v[self.offsets["sr"]]),
+        )
+
+    # -- canonicalization + packing (device) --------------------------------
+
+    def canonicalize(self, vecs):
+        """Sort set-valued slot groups descending: [..., F] -> [..., F].
+
+        apiState slots and each client's list-result slots are TLA sets;
+        descending word order is the canonical representative (present bit is
+        the top used bit, so present slots sort first).
+        """
+        api = self.sl("api")
+        out = vecs.at[..., api].set(
+            -jnp.sort(-vecs[..., api], axis=-1)
+        )
+        lo_off = self.offsets["lreq_obj"]
+        if self.ls > 1:
+            for ci in range(self.nc):
+                s = slice(lo_off + ci * self.ls, lo_off + (ci + 1) * self.ls)
+                out = out.at[..., s].set(-jnp.sort(-out[..., s], axis=-1))
+        return out
+
+    def pack(self, vecs):
+        """[..., F] int32 field vectors -> [..., W] uint32 packed words."""
+        v = vecs.astype(jnp.uint32)
+        lanes = []  # (field lane array [...,], width)
+        for f in self.fields:
+            off = self.offsets[f.name]
+            for j in range(f.count):
+                lanes.append((v[..., off + j], f.width))
+        words = []
+        cur = None
+        cur_bits = 0
+        for lane, width in lanes:
+            remaining = lane
+            rbits = width
+            while rbits > 0:
+                if cur is None:
+                    cur = jnp.zeros_like(lane)
+                    cur_bits = 0
+                take = min(rbits, 32 - cur_bits)
+                cur = cur | ((remaining & ((jnp.uint32(1) << take) - jnp.uint32(1))) << cur_bits)
+                remaining = remaining >> take
+                rbits -= take
+                cur_bits += take
+                if cur_bits == 32:
+                    words.append(cur)
+                    cur = None
+        if cur is not None:
+            words.append(cur)
+        return jnp.stack(words, axis=-1)
+
+    # -- kernel-facing structured view --------------------------------------
+
+    def to_sdict(self, vec):
+        """[F] field vector -> structured dict (kernel working form)."""
+        return {
+            "api": vec[self.sl("api")],
+            "req": vec[self.sl("req")],
+            "lreq_meta": vec[self.sl("lreq_meta")],
+            "lreq_obj": vec[self.sl("lreq_obj")].reshape(self.nc, self.ls),
+            "pc": vec[self.sl("pc")],
+            "stack": vec[self.sl("stack")],
+            "p_op": vec[self.sl("p_op")],
+            "p_obj": vec[self.sl("p_obj")],
+            "p_kind": vec[self.sl("p_kind")],
+            "sr": vec[self.offsets["sr"]],
+        }
+
+    def from_sdict(self, sd):
+        """Structured dict -> [F] field vector."""
+        return jnp.concatenate(
+            [
+                sd["api"],
+                sd["req"],
+                sd["lreq_meta"],
+                sd["lreq_obj"].reshape(self.nc * self.ls),
+                sd["pc"],
+                sd["stack"],
+                sd["p_op"],
+                sd["p_obj"],
+                sd["p_kind"],
+                sd["sr"][None],
+            ]
+        )
+
+    def pack_host(self, vec) -> int:
+        """Host packer (python int) - property-test reference for pack()."""
+        v = np.asarray(vec, dtype=np.int64)
+        out, pos = 0, 0
+        for f in self.fields:
+            off = self.offsets[f.name]
+            for j in range(f.count):
+                out |= (int(v[off + j]) & ((1 << f.width) - 1)) << pos
+                pos += f.width
+        assert pos == self.nbits
+        return out
+
+
+@functools.lru_cache(maxsize=None)
+def get_codec(cfg: ModelConfig) -> Codec:
+    return Codec(cfg)

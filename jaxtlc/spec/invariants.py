@@ -1,0 +1,92 @@
+"""Vectorized invariant kernels: TypeOK + OnlyOneVersion.
+
+TLC evaluates the configured invariants (MC.cfg:13-15) on every distinct
+state (coverage blocks at /root/reference/KubeAPI.toolbox/Model_1/MC.out:1020
+TypeOK, :1074 OnlyOneVersion).  Here they are branch-free predicate kernels
+over encoded field vectors, evaluated on every candidate successor in the
+same fused pass as expansion (SURVEY.md §2.3 E6: "vectorized predicate
+kernels fused into the next-state pass").
+
+The codec discharges parts of TypeOK by construction (field widths cannot
+express an out-of-enum op, for instance), but every clause with runtime
+content is checked for real: identity ranges, status/op ranges, the
+listed-object kind agreement `\\A o \\in r.objs: o.k = r.kind`
+(KubeAPI.tla:434-435), and OnlyOneVersion's pairwise identity uniqueness
+(KubeAPI.tla:787-789) - the latter is a genuine check because the codec uses
+anonymous object slots, so a buggy transition *could* materialize two
+versions of one identity.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from .codec import get_codec
+
+
+def make_invariant_kernel(cfg: ModelConfig):
+    """Build ``check(vec[F]) -> ok_bits int32`` (bit0 TypeOK, bit1
+    OnlyOneVersion; a set bit means the invariant HOLDS)."""
+    cdc = get_codec(cfg)
+    ni, nc, ls = cdc.ni, cdc.nc, cdc.ls
+    n_ident = cfg.n_identities
+    n_kinds = len(cfg.kinds)
+    ident_kind = jnp.asarray([cdc.kind_id[k] for k, _ in cfg.identities], jnp.int32)
+
+    def present(w):
+        return (w >> cdc.o_present) & 1
+
+    def ident(w):
+        return (w >> cdc.o_ident) & ((1 << cdc.ib) - 1)
+
+    def obj_ok(w):
+        """IsValidAPIObject (KubeAPI.tla:378-384) over an object word."""
+        return jnp.where(present(w) == 1, ident(w) < n_ident, w == 0)
+
+    def check(vec):
+        sd = cdc.to_sdict(vec)
+        api, req, lm, lo = sd["api"], sd["req"], sd["lreq_meta"], sd["lreq_obj"]
+
+        # TypeOK (KubeAPI.tla:776-781)
+        ok = obj_ok(api).all()
+        rp = ((req >> cdc.r_present) & 1) == 1
+        r_op = (req >> cdc.r_op) & 7
+        r_st = (req >> cdc.r_status) & 3
+        r_obj = (req >> cdc.r_obj) & ((1 << cdc.obj_bits) - 1)
+        req_ok = (~rp) | (
+            (r_op <= 4) & (r_st <= 2) & (present(r_obj) == 1) & obj_ok(r_obj)
+        )
+        ok &= req_ok.all()
+        lp = ((lm >> cdc.lm_present) & 1) == 1
+        l_kind = (lm >> cdc.lm_kind) & ((1 << cdc.kb) - 1)
+        l_st = (lm >> cdc.lm_status) & 3
+        lo_pres = present(lo) == 1  # [nc, ls]
+        lo_kind = jnp.take(ident_kind, ident(lo))  # [nc, ls]
+        objs_ok = (~lo_pres | (obj_ok(lo).astype(bool) & (lo_kind == l_kind[:, None]))).all(
+            axis=1
+        )
+        # absent list request must have all-zero slots (canonical form)
+        objs_zero = (lo == 0).all(axis=1)
+        lreq_ok = jnp.where(lp, (l_kind < n_kinds) & (l_st <= 2) & objs_ok, objs_zero)
+        ok &= lreq_ok.all()
+        type_ok = ok
+
+        # OnlyOneVersion (KubeAPI.tla:787-789): pairwise identity uniqueness
+        pres = present(api) == 1
+        ids = ident(api)
+        pair = (pres[:, None] & pres[None, :]) & (ids[:, None] == ids[None, :])
+        pair = pair & ~jnp.eye(ni, dtype=bool)
+        only_one = ~pair.any()
+
+        return type_ok.astype(jnp.int32) | (only_one.astype(jnp.int32) << 1)
+
+    return check
+
+
+@functools.lru_cache(maxsize=None)
+def batched_invariants(cfg: ModelConfig):
+    return jax.jit(jax.vmap(make_invariant_kernel(cfg)))

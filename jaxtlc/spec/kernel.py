@@ -1,0 +1,553 @@
+"""Vmapped next-state kernel for the KubeAPI action system.
+
+The TPU-native replacement for TLC's worker successor generation
+(tlc2.tool.Worker, evidenced at
+/root/reference/KubeAPI.toolbox/Model_1/MC.out:5): one branch-free function
+``step(state) -> (succ[L, F], valid[L], action[L], assert_fail[L],
+overflow[L])`` that enumerates *every* satisfying assignment of Next
+(/root/reference/KubeAPI.tla:760-763) as a statically-shaped lane.  `vmap`
+lifts it over the frontier batch; all nondeterminism (SURVEY.md §3.4) is
+unrolled into lanes:
+
+* lanes [0, CL)          - Client process (pc-dispatched over its labels)
+* lanes [CL, 2*CL)       - PVCController process
+* lanes [2*CL, 2*CL+NC)  - APIServer servicing client c's pending request
+* lanes [.., 2*CL+2*NC)  - APIServer servicing client c's pending list
+
+where CL = max(3, LS): 3 covers DoRequest's per-disjunct failure lanes
+(KubeAPI.tla:471-483 - the Error branch fires once per true constant, see
+oracle.py), LS covers `with s \\in listRequests[self].objs` fan-out
+(KubeAPI.tla:618-629, :673-688).
+
+Per-label handlers are ordinary jnp expressions combined with `where`
+selects on pc - no data-dependent Python control flow, so the whole step
+jits to a single fused XLA computation (branchless dispatch is the TPU idiom
+replacing TLC's Java virtual dispatch).
+
+Inline assertions (KubeAPI.tla:196, :216, :348) surface as per-lane
+`assert_fail` flags evaluated when their action fires, exactly when TLC
+evaluates them.  Slot overflow (scaled configs exceeding codec bounds,
+SURVEY.md §7 hard parts) surfaces as per-lane `overflow` flags.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from .codec import Codec, get_codec
+from .labels import LABEL_ID, VERB_ID
+
+I32 = jnp.int32
+
+
+def _sel(mask, a, b):
+    """Elementwise dict/tuple select (mask scalar bool)."""
+    return jax.tree.map(lambda x, y: jnp.where(mask, x, y), a, b)
+
+
+class Lane(Tuple):
+    pass
+
+
+def make_kernel(cfg: ModelConfig):
+    """Build ``step(vec[F]) -> (succ[L,F], valid[L], action[L], afail[L],
+    overflow[L])`` for one config.  All loops below are over static python
+    ints and unroll at trace time."""
+    cdc = get_codec(cfg)
+    ni, nc, ls = cdc.ni, cdc.nc, cdc.ls
+    CL = max(3, ls)
+    L = 2 * CL + 2 * nc
+
+    fail = bool(cfg.requests_can_fail)
+    timeout = bool(cfg.requests_can_timeout)
+
+    # static tables / constants
+    ident_kind = jnp.asarray(
+        [cdc.kind_id[k] for k, _ in cfg.identities], dtype=I32
+    )
+    pvc_kind = cdc.kind_id.get("PVC", -1)
+    secret_kind = cdc.kind_id.get("Secret", -1)
+    obj_mask = (1 << cdc.obj_bits) - 1
+    vv_field_mask = ((1 << nc) - 1) << cdc.o_vv
+
+    def obj_word(kind: str, name: str, vv=0, has_vv=False, spec=False) -> int:
+        w = (1 << cdc.o_present) | (cfg.identity_id(kind, name) << cdc.o_ident)
+        if has_vv:
+            w |= (1 << cdc.o_hasvv) | (vv << cdc.o_vv)
+        if spec:
+            w |= 1 << cdc.o_spec
+        return w
+
+    SECRET_FOO_W = obj_word("Secret", "foo")
+    PVC_MYPVC_W = obj_word("PVC", "mypvc")
+
+    # -- object word ops ----------------------------------------------------
+
+    def present(w):
+        return (w >> cdc.o_present) & 1
+
+    def ident(w):
+        return (w >> cdc.o_ident) & ((1 << cdc.ib) - 1)
+
+    def kind_of(w):
+        return jnp.take(ident_kind, ident(w))
+
+    def has_spec(w):
+        return (w >> cdc.o_spec) & 1
+
+    def write_w(w):
+        """Write (KubeAPI.tla:395): vv := {} - set has_vv, clear vv bits."""
+        return (w & ~vv_field_mask) | (1 << cdc.o_hasvv)
+
+    def read_w(w, ci: int):
+        """Read (KubeAPI.tla:399): add client ci to vv."""
+        return w | (1 << (cdc.o_vv + ci))
+
+    def unbound_pvc(w):
+        """IsUnboundPVC (KubeAPI.tla:444-446).  The codec guarantees a
+        present spec is exactly [pvname |-> name], so 'no pvname' == 'no
+        spec'."""
+        return (present(w) == 1) & (kind_of(w) == pvc_kind) & (has_spec(w) == 0)
+
+    # -- request word ops ---------------------------------------------------
+
+    def req_word(op_id, obj_w, status_id):
+        return (
+            (1 << cdc.r_present)
+            | (op_id << cdc.r_op)
+            | (status_id << cdc.r_status)
+            | (obj_w << cdc.r_obj)
+        )
+
+    def req_status(w):
+        return (w >> cdc.r_status) & 3
+
+    def req_op(w):
+        return (w >> cdc.r_op) & 7
+
+    def req_obj(w):
+        return (w >> cdc.r_obj) & obj_mask
+
+    def req_with_status(w, status_id):
+        return (w & ~(3 << cdc.r_status)) | (status_id << cdc.r_status)
+
+    def req_with_obj(w, obj_w):
+        return (w & ~(obj_mask << cdc.r_obj)) | (obj_w << cdc.r_obj)
+
+    def lm_word(kind_id, status_id):
+        return (1 << cdc.lm_present) | (kind_id << cdc.lm_kind) | (
+            status_id << cdc.lm_status
+        )
+
+    def lm_status(w):
+        return (w >> cdc.lm_status) & 3
+
+    def lm_kind(w):
+        return (w >> cdc.lm_kind) & ((1 << cdc.kb) - 1)
+
+    def lm_with(w, status_id):
+        return (w & ~(3 << cdc.lm_status)) | (status_id << cdc.lm_status)
+
+    PENDING, OK, ERROR = 0, 1, 2  # RESPONSE_ID order
+
+    # -- state helpers ------------------------------------------------------
+
+    def set_pc(sd, i, label):
+        return {**sd, "pc": sd["pc"].at[i].set(LABEL_ID[label])}
+
+    def call_api(sd, i, ret, verb, obj_w):
+        """call API(op, obj): push frame saving dIV params (KubeAPI.tla
+        :535-539; frames provably always save defaultInitValue - asserted by
+        the codec) and assign op/obj."""
+        frame = (1 << cdc.s_present) | (LABEL_ID[ret] << cdc.s_retpc)
+        sd = {
+            **sd,
+            "stack": sd["stack"].at[i].set(frame),
+            "p_op": sd["p_op"].at[i].set(1 + VERB_ID[verb]),
+            "p_obj": sd["p_obj"].at[i].set(obj_w),
+        }
+        return set_pc(sd, i, "DoRequest")
+
+    def call_listapi(sd, i, ret, kind_name):
+        frame = (
+            (1 << cdc.s_present)
+            | (1 << cdc.s_proc)
+            | (LABEL_ID[ret] << cdc.s_retpc)
+        )
+        sd = {
+            **sd,
+            "stack": sd["stack"].at[i].set(frame),
+            "p_kind": sd["p_kind"].at[i].set(1 + cdc.kind_id[kind_name]),
+        }
+        return set_pc(sd, i, "DoListRequest")
+
+    def api_exists(sd, obj_w):
+        """ObjectExists (KubeAPI.tla:410) + the match mask."""
+        match = (present(sd["api"]) == 1) & (ident(sd["api"]) == ident(obj_w))
+        return match, match.any()
+
+    INVALID = None  # placeholder meaning "lane statically absent"
+
+    # -- per-label handlers: return list of (valid, sdict, afail) -----------
+
+    def h_do_request(sd, i):
+        obj_w = sd["p_obj"][i]
+        op_id = sd["p_op"][i] - 1
+        lanes = []
+        for status, on in ((PENDING, True), (ERROR, fail), (ERROR, timeout)):
+            if not on:
+                lanes.append(INVALID)
+                continue
+            nxt = set_pc(
+                {**sd, "req": sd["req"].at[i].set(req_word(op_id, obj_w, status))},
+                i,
+                "DoReply",
+            )
+            lanes.append((jnp.bool_(True), nxt, jnp.bool_(False)))
+        return lanes
+
+    def h_do_reply(sd, i):
+        rw = sd["req"][i]
+        guard = req_status(rw) != PENDING
+        frame = sd["stack"][i]
+        retpc = (frame >> cdc.s_retpc) & ((1 << cdc.lb) - 1)
+        popped = {
+            **sd,
+            "pc": sd["pc"].at[i].set(retpc),
+            "stack": sd["stack"].at[i].set(0),
+            "p_op": sd["p_op"].at[i].set(0),
+            "p_obj": sd["p_obj"].at[i].set(0),
+        }
+        lanes = [(guard, popped, jnp.bool_(False))]
+        if timeout:
+            erred = {**popped, "req": popped["req"].at[i].set(req_with_status(rw, ERROR))}
+            lanes.append((guard, erred, jnp.bool_(False)))
+        else:
+            lanes.append(INVALID)
+        lanes.append(INVALID)
+        return lanes
+
+    def h_do_list_request(sd, i):
+        kind_id = sd["p_kind"][i] - 1
+        lanes = []
+        for status, on in ((PENDING, True), (ERROR, fail), (ERROR, timeout)):
+            if not on:
+                lanes.append(INVALID)
+                continue
+            nxt = {
+                **sd,
+                "lreq_meta": sd["lreq_meta"].at[i].set(lm_word(kind_id, status)),
+                "lreq_obj": sd["lreq_obj"].at[i].set(jnp.zeros(ls, I32)),
+            }
+            lanes.append((jnp.bool_(True), set_pc(nxt, i, "DoListReply"), jnp.bool_(False)))
+        return lanes
+
+    def h_do_list_reply(sd, i):
+        lw = sd["lreq_meta"][i]
+        guard = lm_status(lw) != PENDING
+        frame = sd["stack"][i]
+        retpc = (frame >> cdc.s_retpc) & ((1 << cdc.lb) - 1)
+        popped = {
+            **sd,
+            "pc": sd["pc"].at[i].set(retpc),
+            "stack": sd["stack"].at[i].set(0),
+            "p_kind": sd["p_kind"].at[i].set(0),
+        }
+        lanes = [(guard, popped, jnp.bool_(False))]
+        if timeout:
+            erred = {
+                **popped,
+                "lreq_meta": popped["lreq_meta"].at[i].set(lm_with(lw, ERROR)),
+                "lreq_obj": popped["lreq_obj"].at[i].set(jnp.zeros(ls, I32)),
+            }
+            lanes.append((guard, erred, jnp.bool_(False)))
+        else:
+            lanes.append(INVALID)
+        lanes.append(INVALID)
+        return lanes
+
+    def h_cstart(sd, i):
+        # KubeAPI.tla:528-549: lane0 = either-branch shouldReconcile':=TRUE;
+        # lane1 = skip branch; the IF dispatches on the *new* value.
+        recon = call_api({**sd, "sr": jnp.int32(1)}, i, "C1", "Force", SECRET_FOO_W)
+        cleanup = call_listapi({**sd, "sr": jnp.int32(0)}, i, "C3", "Secret")
+        skip = _sel(sd["sr"] == 1, recon, cleanup)
+        return [
+            (jnp.bool_(True), recon, jnp.bool_(False)),
+            (jnp.bool_(True), skip, jnp.bool_(False)),
+            INVALID,
+        ]
+
+    def _branch(sd, i, cond, then_lbl, else_lbl):
+        t = set_pc(sd, i, then_lbl)
+        e = set_pc(sd, i, else_lbl)
+        return [(jnp.bool_(True), _sel(cond, t, e), jnp.bool_(False))]
+
+    def h_c1(sd, i):
+        return _branch(sd, i, req_status(sd["req"][i]) == OK, "C10", "CStart")
+
+    def h_c10(sd, i):
+        return [(jnp.bool_(True), call_api(sd, i, "C11", "Force", PVC_MYPVC_W), jnp.bool_(False))]
+
+    def h_c11(sd, i):
+        return _branch(sd, i, req_status(sd["req"][i]) == OK, "c12", "CStart")
+
+    def h_c12(sd, i):
+        return [(jnp.bool_(True), call_api(sd, i, "C13", "Get", PVC_MYPVC_W), jnp.bool_(False))]
+
+    def h_c13(sd, i):
+        rw = sd["req"][i]
+        ok = (req_status(rw) == OK) & ~unbound_pvc(req_obj(rw))
+        return _branch(sd, i, ok, "C2", "CStart")
+
+    def h_c2(sd, i):
+        # assert ObjectExists(Secret foo) (KubeAPI.tla:196 -> :598-599)
+        _, found = api_exists(sd, jnp.int32(SECRET_FOO_W))
+        nxt = set_pc({**sd, "sr": jnp.int32(0)}, i, "C5")
+        return [(jnp.bool_(True), nxt, ~found)]
+
+    def h_c3(sd, i):
+        return _branch(sd, i, lm_status(sd["lreq_meta"][i]) == OK, "C8", "CStart")
+
+    def h_c8(sd, i):
+        empty = (present(sd["lreq_obj"][i]) == 0).all()
+        return _branch(sd, i, empty, "C4", "C6")
+
+    def h_c6(sd, i):
+        # with s \in listRequests[self].objs: Delete [k |-> s.k, n |-> s.n]
+        # (KubeAPI.tla:618-629) - the target is a BARE record: no vv/spec.
+        lanes = []
+        for j in range(ls):
+            s = sd["lreq_obj"][i, j]
+            bare = (1 << cdc.o_present) | (ident(s) << cdc.o_ident)
+            nxt = call_api(sd, i, "C7", "Delete", bare)
+            lanes.append((present(s) == 1, nxt, jnp.bool_(False)))
+        while len(lanes) < CL:
+            lanes.append(INVALID)
+        return lanes
+
+    def h_c7(sd, i):
+        ok = (req_status(sd["req"][i]) == OK) & (
+            present(sd["lreq_obj"][i]).sum() <= 1
+        )
+        return _branch(sd, i, ok, "C4", "CStart")
+
+    def h_c4(sd, i):
+        _, found = api_exists(sd, jnp.int32(SECRET_FOO_W))
+        return [(jnp.bool_(True), set_pc(sd, i, "C5"), found)]
+
+    def h_c5(sd, i):
+        return [(jnp.bool_(True), set_pc(sd, i, "CStart"), jnp.bool_(False))]
+
+    def h_pvc_start(sd, i):
+        return [
+            (jnp.bool_(True), call_listapi(sd, i, "PVCListedPVCs", "PVC"), jnp.bool_(False))
+        ]
+
+    def h_pvc_listed(sd, i):
+        lw = sd["lreq_meta"][i]
+        any_unbound = unbound_pvc(sd["lreq_obj"][i]).any()
+        ok = (lm_status(lw) == OK) & any_unbound
+        return _branch(sd, i, ok, "PVCHavePVCs", "PVCStart")
+
+    def h_pvc_have(sd, i):
+        # one lane per unbound listed PVC; bound = unb + spec[pvname |-> unb.n]
+        # (KubeAPI.tla:673-688) - in codec terms: set the has_spec bit.
+        lanes = []
+        for j in range(ls):
+            unb = sd["lreq_obj"][i, j]
+            bound = unb | (1 << cdc.o_spec)
+            nxt = call_api(sd, i, "PVCDone", "Update", bound)
+            lanes.append((unbound_pvc(unb), nxt, jnp.bool_(False)))
+        while len(lanes) < CL:
+            lanes.append(INVALID)
+        return lanes
+
+    def h_pvc_done(sd, i):
+        return [(jnp.bool_(True), set_pc(sd, i, "PVCStart"), jnp.bool_(False))]
+
+    CLIENT_HANDLERS = {
+        "DoRequest": h_do_request,
+        "DoReply": h_do_reply,
+        "DoListRequest": h_do_list_request,
+        "DoListReply": h_do_list_reply,
+        "CStart": h_cstart,
+        "C1": h_c1,
+        "C10": h_c10,
+        "C11": h_c11,
+        "c12": h_c12,
+        "C13": h_c13,
+        "C2": h_c2,
+        "C3": h_c3,
+        "C8": h_c8,
+        "C6": h_c6,
+        "C7": h_c7,
+        "C4": h_c4,
+        "C5": h_c5,
+    }
+    PVC_HANDLERS = {
+        "DoRequest": h_do_request,
+        "DoReply": h_do_reply,
+        "DoListRequest": h_do_list_request,
+        "DoListReply": h_do_list_reply,
+        "PVCStart": h_pvc_start,
+        "PVCListedPVCs": h_pvc_listed,
+        "PVCHavePVCs": h_pvc_have,
+        "PVCDone": h_pvc_done,
+    }
+
+    # -- APIServer lanes (KubeAPI.tla:698-756) ------------------------------
+
+    def server_req_lane(sd, c: int):
+        """Service client c's pending single-object request (:699-743)."""
+        rw = sd["req"][c]
+        valid = (((rw >> cdc.r_present) & 1) == 1) & (req_status(rw) == PENDING)
+        op = req_op(rw)
+        robj = req_obj(rw)
+        api = sd["api"]
+        match, found = api_exists(sd, robj)
+        free = present(api) == 0
+        free_idx = jnp.argmax(free)
+        can_insert = free.any()
+        written = write_w(robj)
+        inserted = api.at[free_idx].set(written)  # used under `can_insert`
+
+        # Create (:700-705)
+        create_api = jnp.where(found, api, jnp.where(can_insert, inserted, api))
+        create_st = jnp.where(found, ERROR, OK)
+        create_ovf = ~found & ~can_insert
+        # Force (:706-715)
+        force_api = jnp.where(
+            found, jnp.where(match, written, api), jnp.where(can_insert, inserted, api)
+        )
+        force_st = jnp.full((), OK, I32)
+        force_ovf = ~found & ~can_insert
+        # Get (:716-728): CHOOSE the (single) match; request obj becomes the
+        # PRE-read copy; apiState copy gets vv |= {c}.
+        chosen = jnp.where(match, api, 0).max()  # exactly one match when found
+        get_api = jnp.where(found, jnp.where(match, read_w(api, c), api), api)
+        get_st = jnp.where(found, OK, ERROR)
+        # Delete (:729-731)
+        del_api = jnp.where(match, 0, api)
+        # Update (:732-739): optimistic concurrency via HasRead
+        hasread = (match & (((api >> (cdc.o_vv + c)) & 1) == 1)).any()
+        upd_api = jnp.where(hasread, jnp.where(match, written, api), api)
+        upd_st = jnp.where(hasread, OK, ERROR)
+
+        is_create = op == VERB_ID["Create"]
+        is_force = op == VERB_ID["Force"]
+        is_get = op == VERB_ID["Get"]
+        is_delete = op == VERB_ID["Delete"]
+        is_update = op == VERB_ID["Update"]
+        afail = valid & ~(is_create | is_force | is_get | is_delete | is_update)
+
+        new_api = jnp.where(
+            is_create[..., None] if False else is_create,
+            create_api,
+            jnp.where(
+                is_force,
+                force_api,
+                jnp.where(is_get, get_api, jnp.where(is_delete, del_api, upd_api)),
+            ),
+        )
+        new_st = jnp.where(
+            is_create,
+            create_st,
+            jnp.where(
+                is_force,
+                force_st,
+                jnp.where(is_get, get_st, jnp.where(is_delete, OK, upd_st)),
+            ),
+        )
+        new_rw = req_with_status(rw, new_st)
+        new_rw = jnp.where(is_get & found, req_with_obj(new_rw, chosen), new_rw)
+        overflow = valid & jnp.where(is_create, create_ovf, is_force & force_ovf)
+        nxt = {**sd, "api": new_api, "req": sd["req"].at[c].set(new_rw)}
+        return valid, nxt, afail, overflow
+
+    def server_list_lane(sd, c: int):
+        """Service client c's pending list request (:745-753)."""
+        lw = sd["lreq_meta"][c]
+        valid = (((lw >> cdc.lm_present) & 1) == 1) & (lm_status(lw) == PENDING)
+        kind = lm_kind(lw)
+        api = sd["api"]
+        match = (present(api) == 1) & (kind_of(api) == kind)
+        # compact the PRE-read copies into the ls list slots (descending
+        # canonical order); overflow if more matches than slots
+        matched = jnp.where(match, api, 0)
+        compacted = -jnp.sort(-matched)[:ls]
+        overflow = valid & (match.sum() > ls)
+        new_api = jnp.where(match, read_w(api, c), api)
+        nxt = {
+            **sd,
+            "api": new_api,
+            "lreq_meta": sd["lreq_meta"].at[c].set(lm_with(lw, OK)),
+            "lreq_obj": sd["lreq_obj"].at[c].set(compacted),
+        }
+        return valid, nxt, jnp.bool_(False), overflow
+
+    # -- assemble the full lane vector --------------------------------------
+
+    APISTART_ID = LABEL_ID["APIStart"]
+
+    def step(vec):
+        sd = cdc.to_sdict(vec)
+        zero_lane = (jnp.bool_(False), sd, jnp.int32(0), jnp.bool_(False), jnp.bool_(False))
+        lanes: List = [zero_lane] * L
+
+        for slot_base, i, handlers in (
+            (0, 0, CLIENT_HANDLERS),
+            (CL, 1, PVC_HANDLERS),
+        ):
+            acc = [zero_lane] * CL
+            lbl = sd["pc"][i]
+            for name, handler in handlers.items():
+                mask = lbl == LABEL_ID[name]
+                hl = handler(sd, i)
+                aid = jnp.int32(LABEL_ID[name])
+                for k, lane in enumerate(hl):
+                    if lane is INVALID:
+                        continue
+                    v, s2, af = lane
+                    cand = (mask & v, s2, aid, mask & af, jnp.bool_(False))
+                    acc[k] = _sel(mask, cand, acc[k])
+            for k in range(CL):
+                lanes[slot_base + k] = acc[k]
+
+        for c in range(nc):
+            v, s2, af, ovf = server_req_lane(sd, c)
+            lanes[2 * CL + c] = (v, s2, jnp.int32(APISTART_ID), v & af, ovf)
+            v, s2, af, ovf = server_list_lane(sd, c)
+            lanes[2 * CL + nc + c] = (v, s2, jnp.int32(APISTART_ID), v & af, ovf)
+
+        succs = jnp.stack([cdc.from_sdict(s) for _, s, _, _, _ in lanes])
+        succs = cdc.canonicalize(succs)
+        valid = jnp.stack([v for v, _, _, _, _ in lanes])
+        action = jnp.stack([a for _, _, a, _, _ in lanes])
+        afail = jnp.stack([f for _, _, _, f, _ in lanes])
+        overflow = jnp.stack([o for _, _, _, _, o in lanes])
+        return succs, valid, action, afail, overflow
+
+    step.n_lanes = L
+    step.codec = cdc
+    return step
+
+
+@functools.lru_cache(maxsize=None)
+def batched_kernel(cfg: ModelConfig):
+    """jit(vmap(step)) over a frontier batch: [B,F] -> ([B,L,F], [B,L], ...)."""
+    return jax.jit(jax.vmap(make_kernel(cfg)))
+
+
+def initial_vectors(cfg: ModelConfig) -> np.ndarray:
+    """Init (KubeAPI.tla:455-469) as encoded field vectors (2 states)."""
+    from . import oracle
+
+    cdc = get_codec(cfg)
+    return np.stack([cdc.encode(s) for s in oracle.initial_states(cfg)])
