@@ -1,0 +1,96 @@
+"""Benchmark entry point (driver contract).
+
+Runs the exhaustive Model_1 check on whatever jax.devices() provides (the
+real TPU chip under the driver) and prints ONE machine-parseable JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Baseline: the committed single-host TLC run checked 163,408 distinct states
+in 9.875 s => 16,547 distinct states/s
+(/root/reference/KubeAPI.toolbox/Model_1/MC.out:1098,1107; BASELINE.md).
+
+Correctness is a gate, not an assumption: the run must reproduce TLC's exact
+state counts or this script reports failure instead of a throughput number.
+
+Usage:
+    python bench.py            # Model_1 exhaustive (the comparable number)
+    python bench.py --scaled   # scaled-constants workload (throughput focus)
+"""
+
+import json
+import sys
+
+TLC_DISTINCT_PER_S = 163408 / 9.875  # = 16547/s, MC.out:1098,1107
+EXPECT = (577736, 163408, 124)
+
+
+def main() -> int:
+    scaled = "--scaled" in sys.argv
+    import jax
+
+    from jaxtlc.config import MODEL_1
+    from jaxtlc.engine.bfs import check
+
+    if scaled:
+        from jaxtlc.config import scaled_config
+
+        cfg, kwargs = scaled_config()
+    else:
+        cfg, kwargs = MODEL_1, dict(
+            chunk=1024, queue_capacity=1 << 15, fp_capacity=1 << 20
+        )
+
+    # warm-up run compiles everything (and validates correctness)
+    r = check(cfg, **kwargs)
+    if not scaled and (r.generated, r.distinct, r.depth) != EXPECT:
+        print(
+            json.dumps(
+                {
+                    "metric": "distinct_states_per_s",
+                    "value": 0,
+                    "unit": "states/s",
+                    "vs_baseline": 0,
+                    "error": f"count mismatch: {(r.generated, r.distinct, r.depth)}"
+                    f" != {EXPECT}",
+                }
+            )
+        )
+        return 1
+    if r.violation:
+        print(
+            json.dumps(
+                {
+                    "metric": "distinct_states_per_s",
+                    "value": 0,
+                    "unit": "states/s",
+                    "vs_baseline": 0,
+                    "error": r.violation_name,
+                }
+            )
+        )
+        return 1
+
+    # timed run (compile cached)
+    r = check(cfg, **kwargs)
+    rate = r.distinct / r.wall_s
+    print(
+        json.dumps(
+            {
+                "metric": "distinct_states_per_s",
+                "value": round(rate, 1),
+                "unit": "states/s",
+                "vs_baseline": round(rate / TLC_DISTINCT_PER_S, 2),
+                "workload": "scaled" if scaled else "Model_1",
+                "generated": r.generated,
+                "distinct": r.distinct,
+                "depth": r.depth,
+                "wall_s": round(r.wall_s, 3),
+                "device": str(jax.devices()[0]),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

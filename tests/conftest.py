@@ -1,0 +1,22 @@
+"""Test environment: force CPU with 8 virtual devices.
+
+Tests never grab the TPU (single-chip, shared with bench runs) and always
+see an 8-device mesh so multi-chip sharding paths are exercised exactly as
+the driver's dryrun does (build instructions: xla_force_host_platform_
+device_count on JAX_PLATFORMS=cpu).  Must run before jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: full-state-space runs (minutes on 1 CPU core)"
+    )

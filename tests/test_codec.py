@@ -1,0 +1,78 @@
+"""Codec property tests: encode/decode roundtrip, injectivity, packing
+(SURVEY.md §4 "property-based tests of the state codec")."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jaxtlc.config import ModelConfig
+from jaxtlc.spec import oracle
+from jaxtlc.spec.codec import get_codec
+
+CFG = ModelConfig(False, False)
+
+
+@pytest.fixture(scope="module")
+def reachable():
+    states = []
+    oracle.bfs(CFG, on_level=lambda d, f: states.extend(f))
+    return states
+
+
+def test_roundtrip_all_reachable_ff(reachable):
+    cdc = get_codec(CFG)
+    for s in reachable:
+        assert cdc.decode(cdc.encode(s)) == s
+
+
+def test_injective(reachable):
+    cdc = get_codec(CFG)
+    encs = {tuple(map(int, cdc.encode(s))) for s in reachable}
+    assert len(encs) == len(reachable)
+
+
+def test_pack_host_vs_device(reachable):
+    cdc = get_codec(CFG)
+    sample = reachable[:: max(1, len(reachable) // 100)]
+    arr = jnp.asarray(np.stack([cdc.encode(s) for s in sample]))
+    packed = np.asarray(cdc.pack(arr))
+    for i, s in enumerate(sample):
+        host = cdc.pack_host(cdc.encode(s))
+        dev = 0
+        for w in range(cdc.n_words):
+            dev |= int(packed[i, w]) << (32 * w)
+        assert host == dev
+
+
+def test_canonicalize_fixed_point(reachable):
+    cdc = get_codec(CFG)
+    arr = jnp.asarray(np.stack([cdc.encode(s) for s in reachable[:256]]))
+    assert (np.asarray(cdc.canonicalize(arr)) == np.asarray(arr)).all()
+
+
+def test_canonicalize_sorts_permuted_slots():
+    cdc = get_codec(CFG)
+    s0 = oracle.initial_states(CFG)[1]
+    two = s0._replace(
+        api_state=frozenset(
+            [
+                oracle.rec(k="Secret", n="foo", vv=frozenset()),
+                oracle.rec(k="PVC", n="mypvc", vv=frozenset(["Client"])),
+            ]
+        )
+    )
+    v = cdc.encode(two)
+    sl = cdc.sl("api")
+    swapped = v.copy()
+    swapped[sl] = v[sl][::-1]
+    fixed = np.asarray(cdc.canonicalize(jnp.asarray(swapped[None, :])))[0]
+    assert (fixed == v).all()
+
+
+def test_decode_obj_fields():
+    cdc = get_codec(CFG)
+    o = oracle.rec(
+        k="PVC", n="mypvc", vv=frozenset(["Client", "PVCController"]),
+        spec=oracle.rec(pvname="mypvc"),
+    )
+    assert cdc.decode_obj(cdc.encode_obj(o)) == o

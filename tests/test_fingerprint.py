@@ -1,0 +1,80 @@
+"""FP64 property tests: device 2x32-lane vs host int64 reference
+(VERDICT.md item 5)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jaxtlc.engine.fingerprint import (
+    DEFAULT_FP_INDEX,
+    MASK64,
+    POLYS,
+    affine_basis,
+    collision_probability,
+    fp64_host,
+    fp64_words,
+    is_irreducible,
+)
+
+
+def test_polynomials_are_irreducible_spot_check():
+    for idx in (0, 7, DEFAULT_FP_INDEX, len(POLYS) - 1):
+        assert is_irreducible((1 << 64) | POLYS[idx])
+
+
+def test_device_matches_host_reference():
+    rng = np.random.default_rng(0)
+    nbits = 108
+    words = rng.integers(0, 1 << 32, size=(64, 4), dtype=np.uint64).astype(
+        np.uint32
+    )
+    lo, hi = fp64_words(jnp.asarray(words), nbits)
+    lo, hi = np.asarray(lo), np.asarray(hi)
+    for i in range(0, 64, 7):
+        bits = 0
+        for w in range(4):
+            bits |= int(words[i, w]) << (32 * w)
+        bits &= (1 << nbits) - 1
+        ref = fp64_host(bits, nbits)
+        assert (int(lo[i]) | (int(hi[i]) << 32)) == ref
+
+
+def test_different_fp_index_changes_fingerprints():
+    nbits = 64
+    a = fp64_host(0xDEADBEEF, nbits, fp_index=51)
+    b = fp64_host(0xDEADBEEF, nbits, fp_index=50)
+    assert a != b
+
+
+def test_affine_property():
+    # fp(a ^ b) ^ fp(0) == (fp(a) ^ fp(0)) ^ (fp(b) ^ fp(0)) for GF(2) maps
+    nbits = 80
+    z = fp64_host(0, nbits)
+    a, b = 0x123456789ABC, 0xF0F0F0F0F0F0
+    assert (fp64_host(a ^ b, nbits) ^ z) == (
+        (fp64_host(a, nbits) ^ z) ^ (fp64_host(b, nbits) ^ z)
+    )
+
+
+def test_basis_shapes():
+    const, basis = affine_basis(108)
+    assert const.shape == (2,) and basis.shape == (108, 2)
+    assert basis.dtype == np.uint32
+
+
+def test_collision_probability_matches_mc_out_scale():
+    # MC.out:41 reports 3.7E-9 *calculated* for its run; TLC's calculated
+    # estimate uses generated*distinct pairs, ours uses distinct^2 - both
+    # must land in the same order of magnitude for this run size.
+    p = collision_probability(163408)
+    assert 1e-10 < p < 1e-8
+
+
+def test_no_trivial_collisions():
+    rng = np.random.default_rng(1)
+    words = rng.integers(0, 1 << 32, size=(2000, 4), dtype=np.uint64).astype(
+        np.uint32
+    )
+    lo, hi = fp64_words(jnp.asarray(words), 108)
+    pairs = {(int(a), int(b)) for a, b in zip(np.asarray(lo), np.asarray(hi))}
+    assert len(pairs) == 2000

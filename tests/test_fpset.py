@@ -1,0 +1,56 @@
+"""Device fingerprint-set tests (E4): exactness vs a python set under
+in-batch duplicates, masking, and load."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jaxtlc.engine.fpset import fpset_insert, fpset_new
+
+
+def test_matches_python_set_with_duplicates():
+    rng = np.random.default_rng(1)
+    s = fpset_new(1 << 12)
+    ins = jax.jit(fpset_insert)
+    seen = set()
+    total_new = 0
+    for _ in range(20):
+        vals = rng.integers(0, 400, size=256)
+        lo = jnp.asarray(vals.astype(np.uint32))
+        hi = jnp.asarray((vals * 7 + 3).astype(np.uint32))
+        mask = rng.random(256) < 0.9
+        s, is_new = ins(s, lo, hi, jnp.asarray(mask))
+        is_new = np.asarray(is_new)
+        assert not is_new[~mask].any()
+        total_new += int(is_new.sum())
+        seen.update(int(v) for v, m in zip(vals, mask) if m)
+    assert int(np.asarray(s.occ).sum()) == len(seen) == total_new
+
+
+def test_in_batch_duplicates_yield_single_new():
+    s = fpset_new(1 << 8)
+    lo = jnp.asarray(np.array([5, 5, 5, 9], dtype=np.uint32))
+    hi = jnp.asarray(np.array([1, 1, 1, 2], dtype=np.uint32))
+    s, new = fpset_insert(s, lo, hi, jnp.ones(4, bool))
+    assert int(np.asarray(new).sum()) == 2
+    s, new = fpset_insert(s, lo, hi, jnp.ones(4, bool))
+    assert int(np.asarray(new).sum()) == 0
+
+
+def test_zero_fingerprint_is_representable():
+    # fp == (0, 0) must work: occupancy is a separate mask, not a sentinel
+    s = fpset_new(1 << 8)
+    z = jnp.zeros(1, jnp.uint32)
+    s, new = fpset_insert(s, z, z, jnp.ones(1, bool))
+    assert bool(np.asarray(new)[0])
+    s, new = fpset_insert(s, z, z, jnp.ones(1, bool))
+    assert not bool(np.asarray(new)[0])
+
+
+def test_high_load():
+    s = fpset_new(1 << 10)
+    vals = np.arange(700, dtype=np.uint32)
+    s, new = fpset_insert(
+        s, jnp.asarray(vals), jnp.asarray(vals ^ 0xFFFF), jnp.ones(700, bool)
+    )
+    assert int(np.asarray(new).sum()) == 700

@@ -1,0 +1,93 @@
+"""Oracle differential tests vs the committed TLC run
+(/root/reference/KubeAPI.toolbox/Model_1/MC.out - SURVEY.md §4
+"differential testing against TLC ... mandatory infrastructure")."""
+
+import pytest
+
+from jaxtlc.config import MATRIX, MODEL_1, ModelConfig
+from jaxtlc.spec import oracle
+
+
+def test_two_initial_states():
+    # MC.out:32 "Finished computing initial states: 2 distinct states"
+    inits = oracle.initial_states(MODEL_1)
+    assert len(inits) == 2
+    assert len(set(inits)) == 2
+
+
+def test_ff_corner_counts():
+    r = oracle.bfs(ModelConfig(False, False))
+    assert (r.generated, r.distinct, r.depth) == (17020, 8203, 109)
+    assert not r.violations
+
+
+@pytest.mark.slow
+def test_model1_exact_tlc_parity():
+    # MC.out:1098 (577,736 generated / 163,408 distinct), :1101 (depth 124)
+    r = oracle.bfs(MODEL_1)
+    assert (r.generated, r.distinct, r.depth) == (577736, 163408, 124)
+    assert r.max_outdegree == 4  # MC.out:1104
+    assert not r.violations
+
+
+@pytest.mark.slow
+def test_fault_matrix_corners():
+    ft = oracle.bfs(MATRIX[(False, True)])
+    assert (ft.generated, ft.distinct, ft.depth) == (500342, 163408, 124)
+    tf = oracle.bfs(MATRIX[(True, False)])
+    assert (tf.generated, tf.distinct, tf.depth) == (232363, 89084, 128)
+
+
+def test_assert_196_detected():
+    s0 = oracle.initial_states(MODEL_1)[1]
+    bad = s0._replace(pc=("C2", "PVCStart", "APIStart"))
+    succs = oracle.successors(bad, MODEL_1)
+    assert any(x.violation == "assert:196" for x in succs)
+
+
+def test_assert_216_detected():
+    s0 = oracle.initial_states(MODEL_1)[0]
+    api = frozenset([oracle.rec(k="Secret", n="foo", vv=frozenset())])
+    bad = s0._replace(pc=("C4", "PVCStart", "APIStart"), api_state=api)
+    succs = oracle.successors(bad, MODEL_1)
+    assert any(x.violation == "assert:216" for x in succs)
+
+
+def test_only_one_version_detects_duplicates():
+    s0 = oracle.initial_states(MODEL_1)[0]
+    two = frozenset(
+        [
+            oracle.rec(k="Secret", n="foo", vv=frozenset()),
+            oracle.rec(k="Secret", n="foo", vv=frozenset(["Client"])),
+        ]
+    )
+    assert not oracle.only_one_version(s0._replace(api_state=two))
+    assert oracle.only_one_version(s0)
+
+
+def test_type_ok_detects_malformed():
+    s0 = oracle.initial_states(MODEL_1)[0]
+    assert oracle.type_ok(s0)
+    bad = s0._replace(api_state=frozenset([oracle.rec(k="Secret")]))
+    assert not oracle.type_ok(bad)
+
+
+def test_optimistic_concurrency_update_requires_read():
+    # Update without HasRead must fail (KubeAPI.tla:732-739)
+    s0 = oracle.initial_states(MODEL_1)[0]
+    pvc = oracle.rec(k="PVC", n="mypvc", vv=frozenset())
+    req = oracle.rec(op="Update", obj=pvc, status="Pending")
+    st = s0._replace(
+        api_state=frozenset([pvc]),
+        requests=(("PVCController", req),),
+    )
+    lanes = [x for x in oracle._server_lanes(st)]
+    assert len(lanes) == 1
+    new_req = oracle.pmap_get(lanes[0].state.requests, "PVCController")
+    assert oracle.fld(new_req, "status") == "Error"
+    # after the controller has read it, the update succeeds
+    pvc_read = oracle.read(pvc, "PVCController")
+    st2 = st._replace(api_state=frozenset([pvc_read]))
+    lanes = oracle._server_lanes(st2)
+    new_req = oracle.pmap_get(lanes[0].state.requests, "PVCController")
+    assert oracle.fld(new_req, "status") == "Ok"
