@@ -51,6 +51,15 @@ def _home_slot(lo, hi, cap: int):
     return (h & jnp.uint32(cap - 1)).astype(jnp.int32)
 
 
+def home_slot_host(lo: int, hi: int, cap: int) -> int:
+    """Host replica of _home_slot (must match bit-for-bit: initial states are
+    placed host-side and later device probes start from the same slot)."""
+    m = (1 << 32) - 1
+    h = ((lo ^ ((hi * 0x9E3779B1) & m)) * 0x85EBCA6B) & m
+    h ^= h >> 15
+    return h & (cap - 1)
+
+
 def fpset_insert(s: FPSet, lo, hi, mask) -> Tuple[FPSet, jnp.ndarray]:
     """Insert-or-find a batch of fingerprints.
 

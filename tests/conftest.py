@@ -2,16 +2,21 @@
 
 Tests never grab the TPU (single-chip, shared with bench runs) and always
 see an 8-device mesh so multi-chip sharding paths are exercised exactly as
-the driver's dryrun does (build instructions: xla_force_host_platform_
-device_count on JAX_PLATFORMS=cpu).  Must run before jax initializes.
+the driver's dryrun does.  In this environment jax is preloaded with the
+tunnel platform already selected, so plain env vars are too late: we must
+update jax.config before the backend initializes (safe here because pytest
+collection happens before any jax computation).
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
