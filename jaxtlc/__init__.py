@@ -1,0 +1,9 @@
+"""jaxtlc: a TPU-native TLA+ exhaustive model-checking framework.
+
+Executes the KubeAPI action system (reference: JohnStrunk/tla-kubernetes)
+with a vmapped next-state kernel, device-resident fingerprint dedup, and a
+sharded multi-device BFS - reproducing the reference TLC run's verdicts and
+statistics exactly.  See SURVEY.md for the architecture map.
+"""
+
+__version__ = "0.2.0"

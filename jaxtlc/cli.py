@@ -1,0 +1,164 @@
+"""Command-line interface - the TLC invocation contract (E14).
+
+Replaces `java tlc2.TLC -config MC.cfg ...` for the KubeAPI spec family:
+
+    python -m jaxtlc.cli check /path/to/Model_1/MC.cfg \\
+        [-workers tpu] [-fpset JaxFPSet] [-fp 51] [-sharded N] \\
+        [-chunk 1024] [-nodeadlock] [-noTool]
+
+Reads the unmodified reference artifacts (MC.cfg + sibling MC.tla + the
+toolbox .launch if present - BASELINE.json's `-fpset JaxFPSet -workers tpu`
+contract), runs the exhaustive check on the fused device engine (or the
+sharded multi-device engine with -sharded), and emits the TLC structured
+log protocol.  On violation it re-runs in host mode to reconstruct the
+counterexample trace and prints it TLC-style with PlusCal action labels.
+
+Exit codes: 0 = no error; 12 = safety violation (TLC's EC.ExitStatus
+convention for violations); 1 = usage/config error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from . import __version__
+from .config import ModelConfig
+from .engine.fingerprint import DEFAULT_SEED
+from .frontend.model import RunSpec, resolve
+from .io.tlc_log import TLCLog
+
+
+def _run_check(args) -> int:
+    try:
+        spec: RunSpec = resolve(
+            args.config,
+            workers=args.workers,
+            fp_index=args.fp,
+            check_deadlock=not args.nodeadlock,
+        )
+    except (ValueError, OSError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    if args.mutation:
+        spec.model = ModelConfig(
+            spec.model.requests_can_fail,
+            spec.model.requests_can_timeout,
+            spec.model.identities,
+            spec.model.clients,
+            mutation=args.mutation,
+        )
+
+    log = TLCLog(tool_mode=not args.noTool)
+    import jax
+
+    device = str(jax.devices()[0])
+    log.version(__version__)
+    log.banner(spec.fp_index, DEFAULT_SEED, spec.workers, device)
+    log.starting()
+    log.computing_init()
+
+    t0 = time.time()
+    if args.sharded:
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from .engine.sharded import check_sharded
+
+        mesh = Mesh(np.array(jax.devices()[: args.sharded]), ("fp",))
+        r = check_sharded(
+            spec.model,
+            mesh,
+            chunk=args.chunk,
+            queue_capacity=args.qcap,
+            fp_capacity=args.fpcap,
+        )
+    else:
+        from .engine.bfs import check
+
+        r = check(
+            spec.model,
+            chunk=args.chunk,
+            queue_capacity=args.qcap,
+            fp_capacity=args.fpcap,
+            fp_index=spec.fp_index,
+        )
+    log.init_done(2)
+
+    from .engine.bfs import (
+        VIOL_ASSERT,
+        VIOL_DEADLOCK,
+        VIOL_ONLYONEVERSION,
+        VIOL_TYPEOK,
+    )
+
+    violated = r.violation != 0
+    if violated:
+        if r.violation == VIOL_TYPEOK and "TypeOK" in spec.invariants:
+            log.invariant_violated("TypeOK")
+        elif r.violation == VIOL_ONLYONEVERSION and (
+            "OnlyOneVersion" in spec.invariants
+        ):
+            log.invariant_violated("OnlyOneVersion")
+        elif r.violation == VIOL_ASSERT:
+            log.assertion_failed("Failure of PlusCal assertion.")
+        elif r.violation == VIOL_DEADLOCK and spec.check_deadlock:
+            log.deadlock()
+        else:
+            log.msg(1000, f"Run stopped: {r.violation_name}", severity=1)
+        _print_trace(log, spec.model, args.chunk)
+    else:
+        log.success(r.distinct)
+        log.coverage(2, r.action_generated, r.action_distinct)
+
+    log.progress(r.depth, r.generated, r.distinct, r.queue_left)
+    log.final_counts(r.generated, r.distinct, r.queue_left)
+    log.depth(r.depth)
+    avg = round(r.generated / max(1, r.distinct))
+    log.outdegree(avg, 0, 4)
+    log.finished(int((time.time() - t0) * 1000))
+    return 12 if violated else 0
+
+
+def _print_trace(log: TLCLog, model: ModelConfig, chunk: int) -> None:
+    from .engine.trace import find_violation_trace
+    from .spec.pretty import state_to_tla
+
+    found = find_violation_trace(model, chunk=chunk)
+    if found is None:
+        log.msg(1000, "Violation was not reproducible in host mode", severity=1)
+        return
+    _, trace = found
+    for i, (st, act) in enumerate(trace, start=1):
+        log.trace_state(i, act, state_to_tla(st))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="jaxtlc")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    c = sub.add_parser("check", help="exhaustively check a TLC model config")
+    c.add_argument("config", help="path to MC.cfg (sibling MC.tla is read)")
+    c.add_argument("-workers", default="tpu", help="TLC contract knob")
+    c.add_argument("-fpset", default="JaxFPSet", help="TLC contract knob")
+    c.add_argument("-fp", type=int, default=None, help="fp polynomial index")
+    c.add_argument("-sharded", type=int, default=0, metavar="N",
+                   help="run the sharded engine over N devices")
+    c.add_argument("-chunk", type=int, default=1024)
+    c.add_argument("-qcap", type=int, default=1 << 15)
+    c.add_argument("-fpcap", type=int, default=1 << 20)
+    c.add_argument("-nodeadlock", action="store_true")
+    c.add_argument("-noTool", action="store_true",
+                   help="plain text output (no @!@!@ framing)")
+    c.add_argument("-mutation", default="",
+                   help="self-test: run with a deliberately broken "
+                        "transition rule (e.g. delete_noop) to exercise "
+                        "violation detection + trace reconstruction")
+    args = p.parse_args(argv)
+    if args.cmd == "check":
+        return _run_check(args)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

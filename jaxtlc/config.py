@@ -36,6 +36,14 @@ class ModelConfig:
     # slot order.
     clients: Tuple[str, ...] = ("Client", "PVCController")
 
+    # Self-test mutation: deliberately break one transition rule so the
+    # violation-detection + trace-reconstruction pipeline can be exercised
+    # end-to-end (the spec itself is correct, so no real config violates).
+    #   ""            - faithful semantics
+    #   "delete_noop" - server Delete leaves apiState unchanged; the
+    #                   cleanup assert at KubeAPI.tla:216 must then fire
+    mutation: str = ""
+
     @property
     def kinds(self) -> Tuple[str, ...]:
         seen = []

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..config import ModelConfig
 from ..spec.codec import get_codec
+from ..spec.invariants import batched_invariants
 from ..spec.kernel import batched_kernel, initial_vectors
 
 
@@ -46,6 +47,7 @@ def host_bfs(
 ) -> HostBFSResult:
     cdc = get_codec(cfg)
     kern = batched_kernel(cfg)
+    inv_kern = batched_invariants(cfg)
     F = cdc.n_fields
 
     inits = initial_vectors(cfg)
@@ -78,6 +80,9 @@ def host_bfs(
             buf = pad_template.copy()
             buf[:n] = np.stack(batch)
             succs, valid, action, afail, ovf = kern(jnp.asarray(buf))
+            inv_bits = np.asarray(
+                inv_kern(jnp.asarray(succs.reshape(-1, F)))
+            ).reshape(chunk, -1)
             succs = np.asarray(succs)
             valid = np.array(valid)
             valid[n:] = False
@@ -109,6 +114,11 @@ def host_bfs(
                         nxt.append(succs[b, l])
                         if keep_parents:
                             parents[t] = (src_t, aid)
+                        bits = int(inv_bits[b, l])
+                        if bits & 1 == 0:
+                            violations.append(("TypeOK", t))
+                        if bits & 2 == 0:
+                            violations.append(("OnlyOneVersion", t))
                 outdeg = len(succ_set)
                 max_out = max(max_out, outdeg)
                 min_out = min(min_out, outdeg)

@@ -1,0 +1,65 @@
+"""Counterexample trace reconstruction (trace-explorer analog, E11).
+
+TLC reconstructs error traces by walking parent pointers from the violating
+state back to an initial state, then renders them at PlusCal level via the
+.pmap source map (MC_TE.out slot in the reference).  Equivalent here: the
+host driver (engine.hostdriver) records (child -> (parent, action-label))
+for every distinct state; this module walks the chain and yields decoded
+states with the PlusCal action labels that produced them.
+
+The fused device engine does not keep parents (it carries only counters +
+the violating state); on violation the CLI re-runs in host mode - the
+violation is deterministic, so the re-run reproduces it and yields the
+trace.  This mirrors TLC's own design split between the fast checking pass
+and the trace-explorer re-run.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import ModelConfig
+from ..spec.codec import get_codec
+from ..spec.labels import LABELS
+
+
+def reconstruct(
+    parents: Dict[tuple, Tuple[Optional[tuple], int]],
+    violating: tuple,
+) -> List[Tuple[tuple, Optional[str]]]:
+    """Walk child->parent links; returns [(encoded_state, action_label)],
+    first element is an initial state (action None)."""
+    chain: List[Tuple[tuple, Optional[str]]] = []
+    cur: Optional[tuple] = violating
+    while cur is not None:
+        parent, aid = parents[cur]
+        chain.append((cur, LABELS[aid] if aid >= 0 else None))
+        cur = parent
+    chain.reverse()
+    return chain
+
+
+def decode_trace(cfg: ModelConfig, chain):
+    """Decoded (oracle.State, action_label) pairs for rendering."""
+    cdc = get_codec(cfg)
+    return [
+        (cdc.decode(np.asarray(enc, dtype=np.int32)), act) for enc, act in chain
+    ]
+
+
+def find_violation_trace(cfg: ModelConfig, chunk: int = 512):
+    """Re-run in host mode, stop at the first violation, return
+    (kind, [(state, action), ...]) or None if the model is clean."""
+    from .hostdriver import host_bfs
+
+    r = host_bfs(cfg, chunk=chunk, keep_parents=True, stop_on_violation=True)
+    if not r.violations:
+        return None
+    kind, enc = r.violations[0]
+    if enc not in r.parents:
+        # violating successor was never enqueued (e.g. invariant violation on
+        # a candidate): the recorded state is the source; walk from there
+        return kind, decode_trace(cfg, reconstruct(r.parents, enc))
+    return kind, decode_trace(cfg, reconstruct(r.parents, enc))

@@ -1,0 +1,74 @@
+"""TLC configuration (MC.cfg) parser.
+
+Parses the TLC config DSL as exercised by the reference
+(/root/reference/KubeAPI.toolbox/Model_1/MC.cfg:1-15): CONSTANT
+declarations/substitutions, SPECIFICATION, INVARIANT and PROPERTY lists.
+This file pair (MC.cfg + MC.tla) is "the plugin boundary the TPU backend
+must accept unchanged" (SURVEY.md §1 L4->L3); the reference artifacts parse
+as-is.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class TLCConfig:
+    constants: Dict[str, str]  # CONSTANT name = value
+    substitutions: Dict[str, str]  # CONSTANT name <- definition-name
+    specification: Optional[str]
+    invariants: List[str]
+    properties: List[str]
+    init: Optional[str] = None
+    next: Optional[str] = None
+
+
+_SECTION = re.compile(
+    r"^(CONSTANTS?|SPECIFICATION|INVARIANTS?|PROPERTY|PROPERTIES|INIT|NEXT)\b"
+)
+
+
+def parse_cfg(text: str) -> TLCConfig:
+    cfg = TLCConfig({}, {}, None, [], [])
+    section = None
+    for raw in text.splitlines():
+        line = raw.split("\\*")[0].strip()  # \* comments
+        if not line:
+            continue
+        m = _SECTION.match(line)
+        if m:
+            section = m.group(1)
+            line = line[m.end():].strip()
+            if not line:
+                continue
+        if section is None:
+            continue
+        if section.startswith("CONSTANT"):
+            if "<-" in line:
+                name, val = (x.strip() for x in line.split("<-", 1))
+                cfg.substitutions[name] = val
+            elif "=" in line:
+                name, val = (x.strip() for x in line.split("=", 1))
+                cfg.constants[name] = val
+            else:
+                # bare model-value declaration
+                cfg.constants[line] = line
+        elif section == "SPECIFICATION":
+            cfg.specification = line
+        elif section.startswith("INVARIANT"):
+            cfg.invariants.extend(line.split())
+        elif section in ("PROPERTY", "PROPERTIES"):
+            cfg.properties.extend(line.split())
+        elif section == "INIT":
+            cfg.init = line
+        elif section == "NEXT":
+            cfg.next = line
+    return cfg
+
+
+def parse_cfg_file(path: str) -> TLCConfig:
+    with open(path, "r", encoding="utf-8") as f:
+        return parse_cfg(f.read())

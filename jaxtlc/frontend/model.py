@@ -1,0 +1,125 @@
+"""Model resolution: MC.cfg + MC.tla (+ .launch) -> an executable run spec.
+
+This is the L4 model-configuration layer (SURVEY.md §1): the three nested
+config layers of the reference - .launch (Toolbox knobs) -> MC.cfg (TLC
+DSL) -> MC.tla (constant definitions) - resolved against the spec the
+engine can execute.
+
+Spec frontend scope (SURVEY.md §7 item 9): the engine executes the KubeAPI
+action system via hand-written codegen of the committed TLA translation
+(/root/reference/KubeAPI.tla:373-768), generalized over the constants and
+the scaled bounds.  Loading an MC for a different root spec is a clear
+error, not a silent misrun.
+
+The .pmap file (Java-serialized pcal.TLAtoPCalMapping) is the Toolbox's
+generated-TLA -> PlusCal source map used to render traces at PlusCal level;
+our action identifiers *are* the PlusCal labels (the translation names its
+actions after them), so the mapping semantics are native here: traces are
+reported with PlusCal labels + the reference's line numbers (io.tlc_log).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+from ..config import ModelConfig
+from .launch import LaunchConfig, parse_launch_file
+from .mc_cfg import TLCConfig, parse_cfg_file
+from .mc_tla import eval_constant, parse_mc_tla_file
+
+KNOWN_INVARIANTS = ("TypeOK", "OnlyOneVersion")
+KNOWN_PROPERTIES = ("ReconcileCompletes", "CleansUpProperly")
+
+
+@dataclasses.dataclass
+class RunSpec:
+    model: ModelConfig
+    invariants: List[str]
+    properties: List[str]  # declared; liveness checking is deferred (E8)
+    check_deadlock: bool
+    workers: str  # "tpu" | "auto" | int-as-string
+    fp_index: int
+    spec_name: str
+    model_name: str
+
+
+def resolve(
+    cfg_path: str,
+    launch_path: Optional[str] = None,
+    workers: str = "tpu",
+    fp_index: Optional[int] = None,
+    check_deadlock: bool = True,
+) -> RunSpec:
+    """Resolve a run from an MC.cfg (with sibling MC.tla) like TLC would."""
+    cfg: TLCConfig = parse_cfg_file(cfg_path)
+    model_dir = os.path.dirname(os.path.abspath(cfg_path))
+    mc_tla_path = os.path.join(model_dir, "MC.tla")
+    consts = dict(cfg.constants)
+    extends: List[str] = []
+    if os.path.exists(mc_tla_path):
+        mc = parse_mc_tla_file(mc_tla_path)
+        extends = mc.extends
+        for name, defname in cfg.substitutions.items():
+            if defname in mc.definitions:
+                consts[name] = mc.definitions[defname]
+
+    launch: Optional[LaunchConfig] = None
+    if launch_path is None:
+        toolbox_dir = os.path.dirname(model_dir)
+        for f in sorted(os.listdir(toolbox_dir)) if os.path.isdir(toolbox_dir) else []:
+            if f.endswith(".launch"):
+                launch_path = os.path.join(toolbox_dir, f)
+                break
+    if launch_path and os.path.exists(launch_path):
+        launch = parse_launch_file(launch_path)
+
+    spec_name = launch.spec_name if launch else (extends[0] if extends else "")
+    if spec_name not in ("", "KubeAPI"):
+        raise ValueError(
+            f"unsupported root spec {spec_name!r}: this engine executes the "
+            "KubeAPI action system (KubeAPI.tla:373-768); see SURVEY.md §7 "
+            "item 9 for the frontend-generality roadmap"
+        )
+    if cfg.specification not in (None, "Spec"):
+        raise ValueError(f"unsupported SPECIFICATION {cfg.specification!r}")
+
+    def boolify(name: str, default: bool) -> bool:
+        v = consts.get(name, default)
+        if isinstance(v, str):
+            v = eval_constant(v)
+        if not isinstance(v, bool):
+            raise ValueError(f"constant {name} must be BOOLEAN, got {v!r}")
+        return v
+
+    model = ModelConfig(
+        requests_can_fail=boolify("REQUESTS_CAN_FAIL", True),
+        requests_can_timeout=boolify("REQUESTS_CAN_TIMEOUT", True),
+    )
+
+    invariants = [i for i in cfg.invariants if i]
+    for inv in invariants:
+        if inv not in KNOWN_INVARIANTS:
+            raise ValueError(f"unknown INVARIANT {inv!r}")
+    properties = list(cfg.properties)
+    if launch:
+        # launch-level enable/disable flags refine the cfg lists (launch:18-23)
+        enabled_inv = {n for n, on in launch.invariants if on}
+        if launch.invariants:
+            invariants = [i for i in invariants if i in enabled_inv]
+        properties = [n for n, on in launch.properties if on]
+        check_deadlock = launch.check_deadlock
+        if fp_index is None:
+            fp_index = launch.fp_index
+
+    return RunSpec(
+        model=model,
+        invariants=invariants,
+        properties=properties,
+        check_deadlock=check_deadlock,
+        workers=workers,
+        fp_index=51 if fp_index is None else fp_index,
+        spec_name=spec_name or "KubeAPI",
+        model_name=(launch.model_name if launch else os.path.basename(model_dir)),
+    )

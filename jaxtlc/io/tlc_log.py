@@ -1,0 +1,184 @@
+"""TLC structured log protocol emitter.
+
+Reproduces the `@!@!@STARTMSG <code>:<severity> @!@!@ ... @!@!@ENDMSG <code>
+@!@!@` framing the Toolbox parses, with the message codes observed in the
+reference run log (/root/reference/KubeAPI.toolbox/Model_1/MC.out): 2262
+version banner, 2187 config banner, 2185 start, 2189/2190 initial states,
+2200 progress, 2193 success + collision estimates, 2201/2773/2772/2221
+coverage, 2199 final counts, 2194 depth, 2268 outdegree, 2186 finish.
+Error paths use TLC's violation codes (2110 invariant, 2114 deadlock) and
+the 2217 state-trace framing.
+
+Action coverage lines carry the PlusCal label and the reference module line
+of each action (KubeAPI.tla:455-756), so output diffs cleanly against
+MC.out:44-1092's per-action `distinct:generated` lines.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, Optional, TextIO
+
+from ..engine.fingerprint import collision_probability
+
+# reference translation line of each action (module KubeAPI); the trace/
+# coverage rendering uses these to mirror MC.out's "<Action line N ...>"
+ACTION_LINES: Dict[str, int] = {
+    "Init": 455,
+    "DoRequest": 471,
+    "DoReply": 485,
+    "DoListRequest": 499,
+    "DoListReply": 513,
+    "CStart": 528,
+    "C1": 551,
+    "C10": 558,
+    "C11": 570,
+    "c12": 577,
+    "C13": 589,
+    "C2": 596,
+    "C3": 604,
+    "C8": 611,
+    "C6": 618,
+    "C7": 631,
+    "C4": 638,
+    "C5": 645,
+    "PVCStart": 655,
+    "PVCListedPVCs": 665,
+    "PVCHavePVCs": 673,
+    "PVCDone": 690,
+    "APIStart": 698,
+}
+
+
+class TLCLog:
+    def __init__(self, out: TextIO = sys.stdout, tool_mode: bool = True):
+        self.out = out
+        self.tool = tool_mode
+
+    def msg(self, code: int, text: str, severity: int = 0) -> None:
+        if self.tool:
+            self.out.write(f"@!@!@STARTMSG {code}:{severity} @!@!@\n")
+        self.out.write(text.rstrip("\n") + "\n")
+        if self.tool:
+            self.out.write(f"@!@!@ENDMSG {code} @!@!@\n")
+        self.out.flush()
+
+    # -- run lifecycle ------------------------------------------------------
+
+    def version(self, version: str) -> None:
+        self.msg(2262, f"jaxtlc {version} (TPU-native TLA+ model checker)")
+
+    def banner(self, fp_index: int, seed: int, workers: str, device: str) -> None:
+        self.msg(
+            2187,
+            f"Running breadth-first search Model-Checking with fp {fp_index} "
+            f"and seed {seed} with {workers} workers on {device} "
+            "(JaxFPSet, DeviceStateQueue).",
+        )
+
+    def starting(self) -> None:
+        self.msg(2185, f"Starting... ({time.strftime('%Y-%m-%d %H:%M:%S')})")
+
+    def computing_init(self) -> None:
+        self.msg(2189, "Computing initial states...")
+
+    def init_done(self, n: int) -> None:
+        self.msg(
+            2190,
+            f"Finished computing initial states: {n} distinct states "
+            f"generated at {time.strftime('%Y-%m-%d %H:%M:%S')}.",
+        )
+
+    def progress(
+        self, depth: int, generated: int, distinct: int, queue: int
+    ) -> None:
+        self.msg(
+            2200,
+            f"Progress({depth}) at {time.strftime('%Y-%m-%d %H:%M:%S')}: "
+            f"{generated:,} states generated, {distinct:,} distinct states "
+            f"found, {queue:,} states left on queue.",
+        )
+
+    def success(self, distinct: int) -> None:
+        p = collision_probability(distinct)
+        self.msg(
+            2193,
+            "Model checking completed. No error has been found.\n"
+            "  Estimates of the probability that TLC did not check all "
+            "reachable states\n"
+            "  because two distinct states had the same fingerprint:\n"
+            f"  calculated (optimistic):  val = {p:.1E}",
+        )
+
+    def coverage(self, init_count: int, act_gen: Dict[str, int],
+                 act_dist: Dict[str, int]) -> None:
+        self.msg(
+            2201,
+            f"The coverage statistics at {time.strftime('%Y-%m-%d %H:%M:%S')}",
+        )
+        self.msg(2773, f"<Init line {ACTION_LINES['Init']}, col 1 to line "
+                       f"{ACTION_LINES['Init']}, col 4 of module KubeAPI>: "
+                       f"{init_count}:{init_count}")
+        for name, line in ACTION_LINES.items():
+            if name == "Init":
+                continue
+            g = act_gen.get(name, 0)
+            d = act_dist.get(name, 0)
+            if g == 0 and d == 0:
+                continue
+            self.msg(
+                2773,
+                f"<{name} line {line}, col 1 to line {line}, "
+                f"col {len(name)} of module KubeAPI>: {d}:{g}",
+            )
+
+    def final_counts(self, generated: int, distinct: int, queue: int) -> None:
+        self.msg(
+            2199,
+            f"{generated} states generated, {distinct} distinct states "
+            f"found, {queue} states left on queue.",
+        )
+
+    def depth(self, d: int) -> None:
+        self.msg(2194, f"The depth of the complete state graph search is {d}.")
+
+    def outdegree(self, avg: int, mn: int, mx: int) -> None:
+        self.msg(
+            2268,
+            f"The average outdegree of the complete state graph is {avg} "
+            f"(minimum is {mn}, the maximum {mx}).",
+        )
+
+    def finished(self, ms: int) -> None:
+        self.msg(
+            2186,
+            f"Finished in {ms}ms at ({time.strftime('%Y-%m-%d %H:%M:%S')})",
+        )
+
+    # -- violations ---------------------------------------------------------
+
+    def invariant_violated(self, name: str) -> None:
+        self.msg(2110, f"Invariant {name} is violated.", severity=1)
+
+    def deadlock(self) -> None:
+        self.msg(2114, "Deadlock reached.", severity=1)
+
+    def assertion_failed(self, detail: str) -> None:
+        self.msg(
+            2108,
+            f"The first argument of Assert evaluated to FALSE; the second "
+            f"argument was: {detail}",
+            severity=1,
+        )
+
+    def trace_state(self, index: int, action: Optional[str], text: str) -> None:
+        if action is None:
+            head = f"State {index}: <Initial predicate>"
+        else:
+            line = ACTION_LINES.get(action, 0)
+            head = (
+                f"State {index}: <{action} line {line}, col 1 to line {line}, "
+                f"col {len(action)} of module KubeAPI>"
+            )
+        self.msg(2217, head + "\n" + text, severity=1)

@@ -433,8 +433,9 @@ def make_kernel(cfg: ModelConfig):
         chosen = jnp.where(match, api, 0).max()  # exactly one match when found
         get_api = jnp.where(found, jnp.where(match, read_w(api, c), api), api)
         get_st = jnp.where(found, OK, ERROR)
-        # Delete (:729-731)
-        del_api = jnp.where(match, 0, api)
+        # Delete (:729-731); under the "delete_noop" self-test mutation the
+        # removal is skipped so the cleanup assert (KubeAPI.tla:216) fires
+        del_api = api if cfg.mutation == "delete_noop" else jnp.where(match, 0, api)
         # Update (:732-739): optimistic concurrency via HasRead
         hasread = (match & (((api >> (cdc.o_vv + c)) & 1) == 1)).any()
         upd_api = jnp.where(hasread, jnp.where(match, written, api), api)

@@ -375,7 +375,7 @@ def successors(st: State, cfg: ModelConfig) -> List[Succ]:
             out.append(Succ("PVCDone", _goto(st, i, "PVCStart"), None))
 
         elif lbl == "APIStart":
-            out.extend(_server_lanes(st))
+            out.extend(_server_lanes(st, cfg))
 
         else:  # pragma: no cover
             raise AssertionError(f"unknown label {lbl!r}")
@@ -383,7 +383,7 @@ def successors(st: State, cfg: ModelConfig) -> List[Succ]:
     return out
 
 
-def _server_lanes(st: State) -> List[Succ]:
+def _server_lanes(st: State, cfg: ModelConfig) -> List[Succ]:
     """APIStart (KubeAPI.tla:698-756): one lane per pending (list-)client."""
     out: List[Succ] = []
     # \E c \in PendingClients (KubeAPI.tla:441, :699)
@@ -417,7 +417,8 @@ def _server_lanes(st: State) -> List[Succ]:
             else:
                 new_req = rec_from(req, status="Error")
         elif op == "Delete":  # :729-731
-            api = frozenset(o for o in api if not is_version_of(o, robj))
+            if cfg.mutation != "delete_noop":
+                api = frozenset(o for o in api if not is_version_of(o, robj))
             new_req = rec_from(req, status="Ok")
         elif op == "Update":  # :732-739 - optimistic concurrency via HasRead
             if any(is_version_of(o, robj) and has_read(o, c) for o in api):

@@ -1,0 +1,62 @@
+"""TLA+-syntax state rendering for counterexample traces.
+
+Formats decoded oracle states the way TLC prints trace states (one
+`/\\ var = value` conjunct per variable, TLA record/set/function syntax) so
+traces are readable next to the reference artifacts and parseable by
+Toolbox-style tooling.  The pmap capability (SURVEY.md §2.2 M4) - rendering
+at PlusCal level - is covered by the action labels in the trace header;
+variable values print at TLA level exactly like TLC's.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from .labels import DEFAULT_INIT, PROCESSES
+from .oracle import State, fld
+
+
+def _value(v) -> str:
+    if v is None:
+        return "defaultInitValue"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, str):
+        return "defaultInitValue" if v == DEFAULT_INIT else f'"{v}"'
+    if isinstance(v, frozenset):
+        return "{" + ", ".join(sorted(_value(x) for x in v)) + "}"
+    if isinstance(v, tuple):
+        if v and all(isinstance(x, tuple) and len(x) == 2 for x in v):
+            # record
+            return (
+                "[" + ", ".join(f"{k} |-> {_value(val)}" for k, val in v) + "]"
+            )
+        return "<<" + ", ".join(_value(x) for x in v) + ">>"
+    return str(v)
+
+
+def _fn(domain: Iterable[str], values) -> str:
+    pairs = [f"{d} |-> {_value(v)}" for d, v in zip(domain, values)]
+    return "[" + ", ".join(pairs) + "]"
+
+
+def _partial_fn(entries) -> str:
+    if not entries:
+        return "<<>>"  # TLC prints the empty function this way
+    return " @@ ".join(f"{c} :> {_value(r)}" for c, r in entries)
+
+
+def state_to_tla(st: State) -> str:
+    lines = [
+        f"/\\ apiState = {_value(st.api_state)}",
+        f"/\\ requests = {_partial_fn(st.requests)}",
+        f"/\\ listRequests = {_partial_fn(st.list_requests)}",
+        f"/\\ pc = {_fn(PROCESSES, st.pc)}",
+        "/\\ stack = "
+        + _fn(PROCESSES, [tuple(fr for fr in s) for s in st.stack]),
+        f"/\\ op = {_fn(PROCESSES, st.op)}",
+        f"/\\ obj = {_fn(PROCESSES, st.obj)}",
+        f"/\\ kind = {_fn(PROCESSES, st.kind)}",
+        f"/\\ shouldReconcile = [Client |-> {_value(st.should_reconcile)}]",
+    ]
+    return "\n".join(lines)

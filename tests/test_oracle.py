@@ -81,13 +81,13 @@ def test_optimistic_concurrency_update_requires_read():
         api_state=frozenset([pvc]),
         requests=(("PVCController", req),),
     )
-    lanes = [x for x in oracle._server_lanes(st)]
+    lanes = [x for x in oracle._server_lanes(st, MODEL_1)]
     assert len(lanes) == 1
     new_req = oracle.pmap_get(lanes[0].state.requests, "PVCController")
     assert oracle.fld(new_req, "status") == "Error"
     # after the controller has read it, the update succeeds
     pvc_read = oracle.read(pvc, "PVCController")
     st2 = st._replace(api_state=frozenset([pvc_read]))
-    lanes = oracle._server_lanes(st2)
+    lanes = oracle._server_lanes(st2, MODEL_1)
     new_req = oracle.pmap_get(lanes[0].state.requests, "PVCController")
     assert oracle.fld(new_req, "status") == "Ok"

@@ -1,0 +1,58 @@
+"""Counterexample pipeline tests (VERDICT.md item 8: seed a broken rule,
+get a minimal decoded trace with PlusCal labels)."""
+
+import pytest
+
+from jaxtlc.config import ModelConfig
+from jaxtlc.engine.trace import find_violation_trace
+from jaxtlc.spec import oracle
+from jaxtlc.spec.pretty import state_to_tla
+
+# faithful FF corner but with server Delete made a no-op: the cleanup path's
+# `assert ~ObjectExists(Secret foo)` (KubeAPI.tla:216) must fire
+BROKEN = ModelConfig(False, False, mutation="delete_noop")
+
+
+@pytest.fixture(scope="module")
+def violation():
+    return find_violation_trace(BROKEN, chunk=256)
+
+
+def test_mutation_is_caught(violation):
+    assert violation is not None
+    kind, trace = violation
+    assert kind.startswith("assert@action")
+    assert len(trace) >= 2
+
+
+def test_trace_is_a_real_path(violation):
+    _, trace = violation
+    # every step must be a genuine oracle transition with the right label
+    for (prev, _), (cur, act) in zip(trace, trace[1:]):
+        succs = oracle.successors(prev, BROKEN)
+        assert any(x.state == cur and x.label == act for x in succs), act
+    # and it must start at an initial state
+    assert trace[0][0] in oracle.initial_states(BROKEN)
+    assert trace[0][1] is None
+
+
+def test_trace_ends_at_assert_site(violation):
+    _, trace = violation
+    last_state, _ = trace[-1]
+    # the violating expansion is from C4 (the cleanup assert's label)
+    assert "C4" in last_state.pc or any(
+        x.violation for x in oracle.successors(last_state, BROKEN)
+    )
+
+
+def test_trace_renders_tla_syntax(violation):
+    _, trace = violation
+    text = state_to_tla(trace[0][0])
+    assert "/\\ apiState = {}" in text
+    assert "/\\ pc = [Client |-> \"CStart\"" in text
+    assert "shouldReconcile" in text
+
+
+def test_faithful_semantics_have_no_violation():
+    clean = find_violation_trace(ModelConfig(False, False), chunk=256)
+    assert clean is None
