@@ -20,6 +20,7 @@ convention for violations); 1 = usage/config error.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import sys
 import time
 
@@ -42,13 +43,7 @@ def _run_check(args) -> int:
         print(f"Error: {e}", file=sys.stderr)
         return 1
     if args.mutation:
-        spec.model = ModelConfig(
-            spec.model.requests_can_fail,
-            spec.model.requests_can_timeout,
-            spec.model.identities,
-            spec.model.clients,
-            mutation=args.mutation,
-        )
+        spec.model = dataclasses.replace(spec.model, mutation=args.mutation)
 
     log = TLCLog(tool_mode=not args.noTool)
     import jax
@@ -84,7 +79,7 @@ def _run_check(args) -> int:
             fp_capacity=args.fpcap,
             fp_index=spec.fp_index,
         )
-    log.init_done(2)
+    log.init_done(2 ** spec.model.n_reconcilers)
 
     from .engine.bfs import (
         VIOL_ASSERT,
@@ -131,7 +126,7 @@ def _print_trace(log: TLCLog, model: ModelConfig, chunk: int) -> None:
         return
     _, trace = found
     for i, (st, act) in enumerate(trace, start=1):
-        log.trace_state(i, act, state_to_tla(st))
+        log.trace_state(i, act, state_to_tla(st, model))
 
 
 def main(argv=None) -> int:

@@ -43,7 +43,6 @@ from .labels import (
     LABEL_ID,
     PROC_API,
     PROC_LISTAPI,
-    PROCESSES,
     RESPONSES,
     RESPONSE_ID,
     VERBS,
@@ -97,17 +96,18 @@ class Codec:
         self.s_present = self.lb + 1
         self.stk_bits = self.s_present + 1
 
+        self.nr = cfg.n_reconcilers
         self.fields: List[Field] = [
             Field("api", ni, self.obj_bits),
             Field("req", nc, self.req_bits),
             Field("lreq_meta", nc, self.lm_bits),
             Field("lreq_obj", nc * ls, self.obj_bits),
-            Field("pc", len(PROCESSES), self.lb),
+            Field("pc", nc + 1, self.lb),
             Field("stack", nc, self.stk_bits),
             Field("p_op", nc, 3),  # 0 = defaultInitValue, else 1 + verb id
             Field("p_obj", nc, self.obj_bits),  # 0 = dIV (present bit clear)
             Field("p_kind", nc, self.kb + 1),  # 0 = dIV, else 1 + kind id
-            Field("sr", 1, 1),
+            Field("sr", self.nr, 1),  # shouldReconcile, one bit/reconciler
         ]
         self.offsets: Dict[str, int] = {}
         off = 0
@@ -202,7 +202,7 @@ class Codec:
         v[self.sl("pc")] = [LABEL_ID[l] for l in st.pc]
         # stack (client processes only; server never calls, KubeAPI.tla:698)
         stk = v[self.sl("stack")]
-        assert not st.stack[2], "server stack is always empty"
+        assert not st.stack[self.nc], "server stack is always empty"
         for ci in range(self.nc):
             frames = st.stack[ci]
             assert len(frames) <= 1, "procedures never nest (SURVEY.md §7)"
@@ -226,11 +226,12 @@ class Codec:
             ("p_kind", lambda x: 0 if x == DEFAULT_INIT else 1 + self.kind_id[x]),
         ):
             src = {"p_op": st.op, "p_obj": st.obj, "p_kind": st.kind}[name]
-            assert src[2] == DEFAULT_INIT, "server params never assigned"
+            assert src[self.nc] == DEFAULT_INIT, "server params never assigned"
             arr = v[self.sl(name)]
             for ci in range(self.nc):
                 arr[ci] = enc(src[ci])
-        v[self.offsets["sr"]] = int(st.should_reconcile)
+        assert len(st.should_reconcile) == self.nr
+        v[self.sl("sr")] = [int(b) for b in st.should_reconcile]
         return v.astype(np.int32)
 
     def decode(self, vec) -> oracle.State:
@@ -310,7 +311,7 @@ class Codec:
             op=tuple(p_op),
             obj=tuple(p_obj),
             kind=tuple(p_kind),
-            should_reconcile=bool(v[self.offsets["sr"]]),
+            should_reconcile=tuple(bool(x) for x in v[self.sl("sr")]),
         )
 
     # -- canonicalization + packing (device) --------------------------------
@@ -377,7 +378,7 @@ class Codec:
             "p_op": vec[self.sl("p_op")],
             "p_obj": vec[self.sl("p_obj")],
             "p_kind": vec[self.sl("p_kind")],
-            "sr": vec[self.offsets["sr"]],
+            "sr": vec[self.sl("sr")],
         }
 
     def from_sdict(self, sd):
@@ -393,7 +394,7 @@ class Codec:
                 sd["p_op"],
                 sd["p_obj"],
                 sd["p_kind"],
-                sd["sr"][None],
+                sd["sr"],
             ]
         )
 

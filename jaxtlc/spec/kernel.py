@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import ModelConfig
+from ..config import RECONCILER, ModelConfig
 from .codec import Codec, get_codec
 from .labels import LABEL_ID, VERB_ID
 
@@ -62,7 +62,7 @@ def make_kernel(cfg: ModelConfig):
     cdc = get_codec(cfg)
     ni, nc, ls = cdc.ni, cdc.nc, cdc.ls
     CL = max(3, ls)
-    L = 2 * CL + 2 * nc
+    L = nc * CL + 2 * nc
 
     fail = bool(cfg.requests_can_fail)
     timeout = bool(cfg.requests_can_timeout)
@@ -83,9 +83,6 @@ def make_kernel(cfg: ModelConfig):
         if spec:
             w |= 1 << cdc.o_spec
         return w
-
-    SECRET_FOO_W = obj_word("Secret", "foo")
-    PVC_MYPVC_W = obj_word("PVC", "mypvc")
 
     # -- object word ops ----------------------------------------------------
 
@@ -160,6 +157,9 @@ def make_kernel(cfg: ModelConfig):
 
     def set_pc(sd, i, label):
         return {**sd, "pc": sd["pc"].at[i].set(LABEL_ID[label])}
+
+    def set_sr(sd, ri: int, v: int):
+        return {**sd, "sr": sd["sr"].at[ri].set(v)}
 
     def call_api(sd, i, ret, verb, obj_w):
         """call API(op, obj): push frame saving dIV params (KubeAPI.tla
@@ -272,18 +272,6 @@ def make_kernel(cfg: ModelConfig):
         lanes.append(INVALID)
         return lanes
 
-    def h_cstart(sd, i):
-        # KubeAPI.tla:528-549: lane0 = either-branch shouldReconcile':=TRUE;
-        # lane1 = skip branch; the IF dispatches on the *new* value.
-        recon = call_api({**sd, "sr": jnp.int32(1)}, i, "C1", "Force", SECRET_FOO_W)
-        cleanup = call_listapi({**sd, "sr": jnp.int32(0)}, i, "C3", "Secret")
-        skip = _sel(sd["sr"] == 1, recon, cleanup)
-        return [
-            (jnp.bool_(True), recon, jnp.bool_(False)),
-            (jnp.bool_(True), skip, jnp.bool_(False)),
-            INVALID,
-        ]
-
     def _branch(sd, i, cond, then_lbl, else_lbl):
         t = set_pc(sd, i, then_lbl)
         e = set_pc(sd, i, else_lbl)
@@ -292,25 +280,13 @@ def make_kernel(cfg: ModelConfig):
     def h_c1(sd, i):
         return _branch(sd, i, req_status(sd["req"][i]) == OK, "C10", "CStart")
 
-    def h_c10(sd, i):
-        return [(jnp.bool_(True), call_api(sd, i, "C11", "Force", PVC_MYPVC_W), jnp.bool_(False))]
-
     def h_c11(sd, i):
         return _branch(sd, i, req_status(sd["req"][i]) == OK, "c12", "CStart")
-
-    def h_c12(sd, i):
-        return [(jnp.bool_(True), call_api(sd, i, "C13", "Get", PVC_MYPVC_W), jnp.bool_(False))]
 
     def h_c13(sd, i):
         rw = sd["req"][i]
         ok = (req_status(rw) == OK) & ~unbound_pvc(req_obj(rw))
         return _branch(sd, i, ok, "C2", "CStart")
-
-    def h_c2(sd, i):
-        # assert ObjectExists(Secret foo) (KubeAPI.tla:196 -> :598-599)
-        _, found = api_exists(sd, jnp.int32(SECRET_FOO_W))
-        nxt = set_pc({**sd, "sr": jnp.int32(0)}, i, "C5")
-        return [(jnp.bool_(True), nxt, ~found)]
 
     def h_c3(sd, i):
         return _branch(sd, i, lm_status(sd["lreq_meta"][i]) == OK, "C8", "CStart")
@@ -338,12 +314,50 @@ def make_kernel(cfg: ModelConfig):
         )
         return _branch(sd, i, ok, "C4", "CStart")
 
-    def h_c4(sd, i):
-        _, found = api_exists(sd, jnp.int32(SECRET_FOO_W))
-        return [(jnp.bool_(True), set_pc(sd, i, "C5"), found)]
-
     def h_c5(sd, i):
         return [(jnp.bool_(True), set_pc(sd, i, "CStart"), jnp.bool_(False))]
+
+    def make_reconciler_extras(ci: int):
+        """Per-client handlers for the labels that reference the client's own
+        target objects (KubeAPI.tla:176,182) or its shouldReconcile bit."""
+        si, pi = cfg.targets[ci]
+        sk, sn = cfg.identities[si]
+        pk, pn = cfg.identities[pi]
+        secret_w = obj_word(sk, sn)
+        pvc_w = obj_word(pk, pn)
+        ri = cfg.sr_index(ci)
+
+        def h_cstart(sd, i):
+            # KubeAPI.tla:528-549: lane0 = either-branch sr':=TRUE; lane1 =
+            # skip branch; the IF dispatches on the *new* value.
+            recon = call_api(set_sr(sd, ri, 1), i, "C1", "Force", secret_w)
+            cleanup = call_listapi(set_sr(sd, ri, 0), i, "C3", sk)
+            skip = _sel(sd["sr"][ri] == 1, recon, cleanup)
+            return [
+                (jnp.bool_(True), recon, jnp.bool_(False)),
+                (jnp.bool_(True), skip, jnp.bool_(False)),
+                INVALID,
+            ]
+
+        def h_c10(sd, i):
+            return [(jnp.bool_(True), call_api(sd, i, "C11", "Force", pvc_w), jnp.bool_(False))]
+
+        def h_c12(sd, i):
+            return [(jnp.bool_(True), call_api(sd, i, "C13", "Get", pvc_w), jnp.bool_(False))]
+
+        def h_c2(sd, i):
+            # assert ObjectExists(own secret) (KubeAPI.tla:196 -> :598-599)
+            _, found = api_exists(sd, jnp.int32(secret_w))
+            base = sd if cfg.mutation == "sticky_reconcile" else set_sr(sd, ri, 0)
+            nxt = set_pc(base, i, "C5")
+            return [(jnp.bool_(True), nxt, ~found)]
+
+        def h_c4(sd, i):
+            _, found = api_exists(sd, jnp.int32(secret_w))
+            return [(jnp.bool_(True), set_pc(sd, i, "C5"), found)]
+
+        return {"CStart": h_cstart, "C10": h_c10, "c12": h_c12,
+                "C2": h_c2, "C4": h_c4}
 
     def h_pvc_start(sd, i):
         return [
@@ -372,35 +386,36 @@ def make_kernel(cfg: ModelConfig):
     def h_pvc_done(sd, i):
         return [(jnp.bool_(True), set_pc(sd, i, "PVCStart"), jnp.bool_(False))]
 
-    CLIENT_HANDLERS = {
+    PROC_HANDLERS = {
         "DoRequest": h_do_request,
         "DoReply": h_do_reply,
         "DoListRequest": h_do_list_request,
         "DoListReply": h_do_list_reply,
-        "CStart": h_cstart,
+    }
+    RECONCILER_BASE = {
         "C1": h_c1,
-        "C10": h_c10,
         "C11": h_c11,
-        "c12": h_c12,
         "C13": h_c13,
-        "C2": h_c2,
         "C3": h_c3,
         "C8": h_c8,
         "C6": h_c6,
         "C7": h_c7,
-        "C4": h_c4,
         "C5": h_c5,
     }
-    PVC_HANDLERS = {
-        "DoRequest": h_do_request,
-        "DoReply": h_do_reply,
-        "DoListRequest": h_do_list_request,
-        "DoListReply": h_do_list_reply,
+    BINDER_HANDLERS = {
+        **PROC_HANDLERS,
         "PVCStart": h_pvc_start,
         "PVCListedPVCs": h_pvc_listed,
         "PVCHavePVCs": h_pvc_have,
         "PVCDone": h_pvc_done,
     }
+    # per-client handler table (static; resolved at trace time)
+    HANDLERS_BY_CLIENT = [
+        {**PROC_HANDLERS, **RECONCILER_BASE, **make_reconciler_extras(ci)}
+        if cfg.roles[ci] == RECONCILER
+        else BINDER_HANDLERS
+        for ci in range(nc)
+    ]
 
     # -- APIServer lanes (KubeAPI.tla:698-756) ------------------------------
 
@@ -502,10 +517,8 @@ def make_kernel(cfg: ModelConfig):
         zero_lane = (jnp.bool_(False), sd, jnp.int32(0), jnp.bool_(False), jnp.bool_(False))
         lanes: List = [zero_lane] * L
 
-        for slot_base, i, handlers in (
-            (0, 0, CLIENT_HANDLERS),
-            (CL, 1, PVC_HANDLERS),
-        ):
+        for i in range(nc):
+            handlers = HANDLERS_BY_CLIENT[i]
             acc = [zero_lane] * CL
             lbl = sd["pc"][i]
             for name, handler in handlers.items():
@@ -519,13 +532,13 @@ def make_kernel(cfg: ModelConfig):
                     cand = (mask & v, s2, aid, mask & af, jnp.bool_(False))
                     acc[k] = _sel(mask, cand, acc[k])
             for k in range(CL):
-                lanes[slot_base + k] = acc[k]
+                lanes[i * CL + k] = acc[k]
 
         for c in range(nc):
             v, s2, af, ovf = server_req_lane(sd, c)
-            lanes[2 * CL + c] = (v, s2, jnp.int32(APISTART_ID), v & af, ovf)
+            lanes[nc * CL + c] = (v, s2, jnp.int32(APISTART_ID), v & af, ovf)
             v, s2, af, ovf = server_list_lane(sd, c)
-            lanes[2 * CL + nc + c] = (v, s2, jnp.int32(APISTART_ID), v & af, ovf)
+            lanes[nc * CL + nc + c] = (v, s2, jnp.int32(APISTART_ID), v & af, ovf)
 
         succs = jnp.stack([cdc.from_sdict(s) for _, s, _, _, _ in lanes])
         succs = cdc.canonicalize(succs)

@@ -7,11 +7,8 @@ kernel index into these tables, so their integer encodings agree by
 construction.
 """
 
-# Process identifiers (ProcSet, KubeAPI.tla:453)
-CLIENT = "Client"
-PVCCTL = "PVCController"
-SERVER = "Server"
-PROCESSES = (CLIENT, PVCCTL, SERVER)
+# Process identifiers are config-driven (ModelConfig.processes mirrors
+# ProcSet, KubeAPI.tla:453): N reconciler clients + M binders + "Server".
 
 # PlusCal labels == TLA actions (KubeAPI.tla:471-756).
 # Order is the canonical integer encoding used by the codec.

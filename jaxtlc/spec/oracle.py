@@ -12,6 +12,13 @@ for two reasons:
    distinct MC.out:1098; depth 124 MC.out:1101).
 2. Counterexample re-evaluation (trace-explorer analog, SURVEY.md §2.3 E11).
 
+Process structure is config-driven (jaxtlc.config): each RECONCILER client
+runs the `process Client` label machine (KubeAPI.tla:161-220) over its own
+target secret/PVC identities, each BINDER runs `process PVCController`
+(KubeAPI.tla:225-260); Model_1 is the 1x1 instance.  `shouldReconcile` is a
+tuple of per-reconciler booleans (the spec's `[{"Client"} -> BOOLEAN]`,
+KubeAPI.tla:465).
+
 States are immutable nested tuples so they hash; records are represented as
 tuples of sorted (field, value) pairs; TLA sets as frozensets.  No code is
 copied from the reference - the reference is a TLA+ spec, this is an original
@@ -20,19 +27,11 @@ Python implementation of its transition relation.
 
 from __future__ import annotations
 
-from collections import deque
+import itertools
 from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
 
-from ..config import ModelConfig
-from .labels import (
-    CLIENT,
-    DEFAULT_INIT,
-    PROC_API,
-    PROC_LISTAPI,
-    PROCESSES,
-    PVCCTL,
-    SERVER,
-)
+from ..config import RECONCILER, ModelConfig
+from .labels import DEFAULT_INIT, PROC_API, PROC_LISTAPI
 
 # ---------------------------------------------------------------------------
 # Value helpers: records are tuples of sorted (key, value) pairs.
@@ -108,15 +107,12 @@ class State(NamedTuple):
     api_state: frozenset  # set of object records
     requests: tuple  # sorted ((client, request-record), ...) - partial fn
     list_requests: tuple  # sorted ((client, listreq-record), ...)
-    pc: tuple  # (pc[Client], pc[PVCController], pc[Server])
+    pc: tuple  # per-process label, processes = clients + Server
     stack: tuple  # per-process tuple of frames (records)
     op: tuple  # per-process procedure param
     obj: tuple
     kind: tuple
-    should_reconcile: bool  # shouldReconcile["Client"]
-
-
-PIDX = {p: i for i, p in enumerate(PROCESSES)}
+    should_reconcile: tuple  # per-reconciler booleans
 
 
 def pmap_get(m: tuple, c: str):
@@ -137,18 +133,28 @@ def _set(t: tuple, i: int, v) -> tuple:
 
 
 def initial_states(cfg: ModelConfig) -> List[State]:
-    """Init (KubeAPI.tla:455-469): 2 states, shouldReconcile in BOOLEAN."""
+    """Init (KubeAPI.tla:455-469): shouldReconcile ranges over
+    [reconcilers -> BOOLEAN] => 2^R states (2 in Model_1, MC.out:32)."""
+    np_ = cfg.n_clients + 1
     base = dict(
         api_state=frozenset(),
         requests=(),
         list_requests=(),
-        pc=("CStart", "PVCStart", "APIStart"),
-        stack=((), (), ()),
-        op=(DEFAULT_INIT,) * 3,
-        obj=(DEFAULT_INIT,) * 3,
-        kind=(DEFAULT_INIT,) * 3,
+        pc=tuple(
+            "CStart" if r == RECONCILER else "PVCStart" for r in cfg.roles
+        )
+        + ("APIStart",),
+        stack=((),) * np_,
+        op=(DEFAULT_INIT,) * np_,
+        obj=(DEFAULT_INIT,) * np_,
+        kind=(DEFAULT_INIT,) * np_,
     )
-    return [State(should_reconcile=b, **base) for b in (False, True)]
+    return [
+        State(should_reconcile=bits, **base)
+        for bits in itertools.product(
+            (False, True), repeat=cfg.n_reconcilers
+        )
+    ]
 
 
 # ---------------------------------------------------------------------------
@@ -160,10 +166,6 @@ class Succ(NamedTuple):
     label: str  # action label that produced this successor
     state: State
     violation: Optional[str]  # assert-failure id, else None
-
-
-SECRET_FOO = rec(k="Secret", n="foo")
-PVC_MYPVC = rec(k="PVC", n="mypvc")
 
 
 def _ckey(v):
@@ -207,9 +209,15 @@ def successors(st: State, cfg: ModelConfig) -> List[Succ]:
     out: List[Succ] = []
     fail, timeout = cfg.requests_can_fail, cfg.requests_can_timeout
 
-    for self in PROCESSES:
-        i = PIDX[self]
+    for i, self in enumerate(cfg.clients):
         lbl = st.pc[i]
+        is_recon = cfg.roles[i] == RECONCILER
+        if is_recon:
+            si, pi = cfg.targets[i]
+            secret = rec(k=cfg.identities[si][0], n=cfg.identities[si][1])
+            pvc = rec(k=cfg.identities[pi][0], n=cfg.identities[pi][1])
+            secret_kind = cfg.identities[si][0]
+            ri = cfg.sr_index(i)
 
         if lbl == "DoRequest":
             # KubeAPI.tla:471-483 - either deliver Pending or (FAIL \/ TIMEOUT)
@@ -277,12 +285,14 @@ def successors(st: State, cfg: ModelConfig) -> List[Succ]:
             # the NEW value (shouldReconcile').  Both either-branches are
             # always enumerated - when shouldReconcile is already TRUE they
             # coincide, and TLC still counts two generated states.
-            for sr in (True, st.should_reconcile):
-                base = st._replace(should_reconcile=sr)
+            for sr in (True, st.should_reconcile[ri]):
+                base = st._replace(
+                    should_reconcile=_set(st.should_reconcile, ri, sr)
+                )
                 if sr:
-                    nxt = _call_api(base, i, "C1", "Force", SECRET_FOO)
+                    nxt = _call_api(base, i, "C1", "Force", secret)
                 else:
-                    nxt = _call_listapi(base, i, "C3", "Secret")
+                    nxt = _call_listapi(base, i, "C3", secret_kind)
                 out.append(Succ("CStart", nxt, None))
 
         elif lbl == "C1":
@@ -290,14 +300,14 @@ def successors(st: State, cfg: ModelConfig) -> List[Succ]:
             out.append(Succ("C1", _goto(st, i, "C10" if ok else "CStart"), None))
 
         elif lbl == "C10":
-            out.append(Succ("C10", _call_api(st, i, "C11", "Force", PVC_MYPVC), None))
+            out.append(Succ("C10", _call_api(st, i, "C11", "Force", pvc), None))
 
         elif lbl == "C11":
             ok = fld(pmap_get(st.requests, self), "status") == "Ok"
             out.append(Succ("C11", _goto(st, i, "c12" if ok else "CStart"), None))
 
         elif lbl == "c12":
-            out.append(Succ("c12", _call_api(st, i, "C13", "Get", PVC_MYPVC), None))
+            out.append(Succ("c12", _call_api(st, i, "C13", "Get", pvc), None))
 
         elif lbl == "C13":
             req = pmap_get(st.requests, self)
@@ -306,8 +316,13 @@ def successors(st: State, cfg: ModelConfig) -> List[Succ]:
 
         elif lbl == "C2":
             # KubeAPI.tla:596-602 + assert at :196 (translated :598-599)
-            viol = None if object_exists(st.api_state, SECRET_FOO) else "assert:196"
-            nxt = _goto(st._replace(should_reconcile=False), i, "C5")
+            viol = None if object_exists(st.api_state, secret) else "assert:196"
+            sr2 = (
+                st.should_reconcile
+                if cfg.mutation == "sticky_reconcile"
+                else _set(st.should_reconcile, ri, False)
+            )
+            nxt = _goto(st._replace(should_reconcile=sr2), i, "C5")
             out.append(Succ("C2", nxt, viol))
 
         elif lbl == "C3":
@@ -332,7 +347,7 @@ def successors(st: State, cfg: ModelConfig) -> List[Succ]:
             out.append(Succ("C7", _goto(st, i, "C4" if ok else "CStart"), None))
 
         elif lbl == "C4":
-            viol = "assert:216" if object_exists(st.api_state, SECRET_FOO) else None
+            viol = "assert:216" if object_exists(st.api_state, secret) else None
             out.append(Succ("C4", _goto(st, i, "C5"), viol))
 
         elif lbl == "C5":
@@ -374,12 +389,10 @@ def successors(st: State, cfg: ModelConfig) -> List[Succ]:
         elif lbl == "PVCDone":
             out.append(Succ("PVCDone", _goto(st, i, "PVCStart"), None))
 
-        elif lbl == "APIStart":
-            out.extend(_server_lanes(st, cfg))
-
         else:  # pragma: no cover
             raise AssertionError(f"unknown label {lbl!r}")
 
+    out.extend(_server_lanes(st, cfg))
     return out
 
 

@@ -12,8 +12,9 @@ from __future__ import annotations
 
 from typing import Iterable
 
-from .labels import DEFAULT_INIT, PROCESSES
-from .oracle import State, fld
+from ..config import ModelConfig
+from .labels import DEFAULT_INIT
+from .oracle import State
 
 
 def _value(v) -> str:
@@ -46,17 +47,19 @@ def _partial_fn(entries) -> str:
     return " @@ ".join(f"{c} :> {_value(r)}" for c, r in entries)
 
 
-def state_to_tla(st: State) -> str:
+def state_to_tla(st: State, cfg: ModelConfig) -> str:
+    procs = cfg.processes
+    reconcilers = [cfg.clients[i] for i in cfg.reconciler_indices]
     lines = [
         f"/\\ apiState = {_value(st.api_state)}",
         f"/\\ requests = {_partial_fn(st.requests)}",
         f"/\\ listRequests = {_partial_fn(st.list_requests)}",
-        f"/\\ pc = {_fn(PROCESSES, st.pc)}",
+        f"/\\ pc = {_fn(procs, st.pc)}",
         "/\\ stack = "
-        + _fn(PROCESSES, [tuple(fr for fr in s) for s in st.stack]),
-        f"/\\ op = {_fn(PROCESSES, st.op)}",
-        f"/\\ obj = {_fn(PROCESSES, st.obj)}",
-        f"/\\ kind = {_fn(PROCESSES, st.kind)}",
-        f"/\\ shouldReconcile = [Client |-> {_value(st.should_reconcile)}]",
+        + _fn(procs, [tuple(fr for fr in s) for s in st.stack]),
+        f"/\\ op = {_fn(procs, st.op)}",
+        f"/\\ obj = {_fn(procs, st.obj)}",
+        f"/\\ kind = {_fn(procs, st.kind)}",
+        f"/\\ shouldReconcile = {_fn(reconcilers, st.should_reconcile)}",
     ]
     return "\n".join(lines)

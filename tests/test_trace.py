@@ -47,7 +47,7 @@ def test_trace_ends_at_assert_site(violation):
 
 def test_trace_renders_tla_syntax(violation):
     _, trace = violation
-    text = state_to_tla(trace[0][0])
+    text = state_to_tla(trace[0][0], BROKEN)
     assert "/\\ apiState = {}" in text
     assert "/\\ pc = [Client |-> \"CStart\"" in text
     assert "shouldReconcile" in text
