@@ -336,7 +336,7 @@ def make_sharded_engine(
     )
     run_fn = jax.jit(
         shard_map(device_loop, mesh=mesh, in_specs=(specs,), out_specs=specs,
-                  check_rep=False)
+                  check_vma=False)
     )
     return init_fn, run_fn
 
