@@ -1,6 +1,6 @@
 """Benchmark entry point (driver contract).
 
-Runs the exhaustive Model_1 check on whatever jax.devices() provides (the
+Runs an exhaustive state-space check on whatever jax.devices() provides (the
 real TPU chip under the driver) and prints ONE machine-parseable JSON line:
 
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -9,54 +9,55 @@ Baseline: the committed single-host TLC run checked 163,408 distinct states
 in 9.875 s => 16,547 distinct states/s
 (/root/reference/KubeAPI.toolbox/Model_1/MC.out:1098,1107; BASELINE.md).
 
-Correctness is a gate, not an assumption: the run must reproduce TLC's exact
-state counts or this script reports failure instead of a throughput number.
+Correctness is a gate, not an assumption: the run must reproduce the exact
+expected state counts (TLC's for Model_1; oracle-pinned for the scaled
+workload) or this script reports failure instead of a throughput number.
+
+The fused engine loop is AOT-compiled before the timed run (compile time is
+excluded, matching how TLC's figure excludes JVM/startup costs).
 
 Usage:
     python bench.py            # Model_1 exhaustive (the comparable number)
-    python bench.py --scaled   # scaled-constants workload (throughput focus)
+    python bench.py --scaled   # scaled-constants workload (throughput focus;
+                               # 2 reconcilers x 1 binder, 19.36M states)
 """
 
 import json
 import sys
 
 TLC_DISTINCT_PER_S = 163408 / 9.875  # = 16547/s, MC.out:1098,1107
-EXPECT = (577736, 163408, 124)
+EXPECT = {
+    # workload -> (generated, distinct, depth)
+    "Model_1": (577736, 163408, 124),  # MC.out:1098,1101
+    "scaled": (62014325, 19359985, 186),  # oracle-validated family, pinned
+}
 
 
 def main() -> int:
     scaled = "--scaled" in sys.argv
+    workload = "scaled" if scaled else "Model_1"
     import jax
 
-    from jaxtlc.config import MODEL_1
+    from jaxtlc.config import MODEL_1, scaled_config
     from jaxtlc.engine.bfs import check
 
     if scaled:
-        from jaxtlc.config import scaled_config
-
         cfg, kwargs = scaled_config()
     else:
         cfg, kwargs = MODEL_1, dict(
             chunk=1024, queue_capacity=1 << 15, fp_capacity=1 << 20
         )
 
-    # warm-up run compiles everything (and validates correctness)
     r = check(cfg, **kwargs)
-    if not scaled and (r.generated, r.distinct, r.depth) != EXPECT:
-        print(
-            json.dumps(
-                {
-                    "metric": "distinct_states_per_s",
-                    "value": 0,
-                    "unit": "states/s",
-                    "vs_baseline": 0,
-                    "error": f"count mismatch: {(r.generated, r.distinct, r.depth)}"
-                    f" != {EXPECT}",
-                }
-            )
-        )
-        return 1
+    fail = None
     if r.violation:
+        fail = r.violation_name
+    elif (r.generated, r.distinct, r.depth) != EXPECT[workload]:
+        fail = (
+            f"count mismatch: {(r.generated, r.distinct, r.depth)}"
+            f" != {EXPECT[workload]}"
+        )
+    if fail:
         print(
             json.dumps(
                 {
@@ -64,14 +65,12 @@ def main() -> int:
                     "value": 0,
                     "unit": "states/s",
                     "vs_baseline": 0,
-                    "error": r.violation_name,
+                    "error": fail,
                 }
             )
         )
         return 1
 
-    # timed run (compile cached)
-    r = check(cfg, **kwargs)
     rate = r.distinct / r.wall_s
     print(
         json.dumps(
@@ -80,7 +79,7 @@ def main() -> int:
                 "value": round(rate, 1),
                 "unit": "states/s",
                 "vs_baseline": round(rate / TLC_DISTINCT_PER_S, 2),
-                "workload": "scaled" if scaled else "Model_1",
+                "workload": workload,
                 "generated": r.generated,
                 "distinct": r.distinct,
                 "depth": r.depth,

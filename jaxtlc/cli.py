@@ -44,6 +44,15 @@ def _run_check(args) -> int:
         return 1
     if args.mutation:
         spec.model = dataclasses.replace(spec.model, mutation=args.mutation)
+    if args.recover and not args.checkpoint:
+        print("Error: -recover requires -checkpoint PATH", file=sys.stderr)
+        return 1
+    if args.checkpoint and args.sharded:
+        print(
+            "Error: -checkpoint is not supported with -sharded yet",
+            file=sys.stderr,
+        )
+        return 1
 
     log = TLCLog(tool_mode=not args.noTool)
     import jax
@@ -68,6 +77,19 @@ def _run_check(args) -> int:
             chunk=args.chunk,
             queue_capacity=args.qcap,
             fp_capacity=args.fpcap,
+        )
+    elif args.checkpoint:
+        from .engine.checkpoint import check_with_checkpoints
+
+        r = check_with_checkpoints(
+            spec.model,
+            chunk=args.chunk,
+            queue_capacity=args.qcap,
+            fp_capacity=args.fpcap,
+            fp_index=spec.fp_index,
+            ckpt_path=args.checkpoint,
+            ckpt_every=args.checkpointevery,
+            resume=args.recover,
         )
     else:
         from .engine.bfs import check
@@ -142,6 +164,13 @@ def main(argv=None) -> int:
     c.add_argument("-chunk", type=int, default=1024)
     c.add_argument("-qcap", type=int, default=1 << 15)
     c.add_argument("-fpcap", type=int, default=1 << 20)
+    c.add_argument("-checkpoint", default="", metavar="PATH",
+                   help="periodic engine snapshots to PATH (TLC checkpoint "
+                        "analog); resume with -recover")
+    c.add_argument("-checkpointevery", type=int, default=256, metavar="N",
+                   help="chunks between checkpoints")
+    c.add_argument("-recover", action="store_true",
+                   help="resume from -checkpoint PATH (TLC -recover analog)")
     c.add_argument("-nodeadlock", action="store_true")
     c.add_argument("-noTool", action="store_true",
                    help="plain text output (no @!@!@ framing)")

@@ -184,5 +184,6 @@ def scaled_config():
     (BASELINE.json): a frontier wide enough to keep the MXU/VPU busy, unlike
     Model_1 whose peak frontier is ~906 states (MC.out:35).
     """
-    cfg = make_scaled(n_reconcilers=2, n_binders=1)
-    return cfg, dict(chunk=8192, queue_capacity=1 << 22, fp_capacity=1 << 26)
+    cfg = make_scaled(n_reconcilers=2, n_binders=1, requests_can_fail=False,
+                      requests_can_timeout=False)
+    return cfg, dict(chunk=4096, queue_capacity=1 << 21, fp_capacity=1 << 25)

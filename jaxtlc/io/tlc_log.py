@@ -52,8 +52,10 @@ ACTION_LINES: Dict[str, int] = {
 
 
 class TLCLog:
-    def __init__(self, out: TextIO = sys.stdout, tool_mode: bool = True):
-        self.out = out
+    def __init__(self, out: Optional[TextIO] = None, tool_mode: bool = True):
+        # resolve sys.stdout at call time (a def-time default would pin the
+        # stream before test harnesses / redirections can swap it)
+        self.out = sys.stdout if out is None else out
         self.tool = tool_mode
 
     def msg(self, code: int, text: str, severity: int = 0) -> None:

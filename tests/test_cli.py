@@ -1,0 +1,90 @@
+"""End-to-end CLI tests (E14): TLC invocation contract, structured log
+protocol, exit codes, counterexample trace printing, checkpoint flags."""
+
+import os
+
+import pytest
+
+from jaxtlc.cli import main
+
+MC_TLA = """---- MODULE MC ----
+EXTENDS KubeAPI, TLC
+
+\\* CONSTANT definitions @modelParameterConstants:1REQUESTS_CAN_FAIL
+const_fail ==
+FALSE
+
+\\* CONSTANT definitions @modelParameterConstants:2REQUESTS_CAN_TIMEOUT
+const_to ==
+FALSE
+====
+"""
+
+MC_CFG = """CONSTANT defaultInitValue = defaultInitValue
+CONSTANT REQUESTS_CAN_FAIL <- const_fail
+CONSTANT REQUESTS_CAN_TIMEOUT <- const_to
+SPECIFICATION Spec
+INVARIANT TypeOK
+INVARIANT OnlyOneVersion
+"""
+
+
+@pytest.fixture()
+def model_dir(tmp_path):
+    d = tmp_path / "Model_FF"
+    d.mkdir()
+    (d / "MC.tla").write_text(MC_TLA)
+    (d / "MC.cfg").write_text(MC_CFG)
+    return d
+
+
+SMALL = ["-chunk", "128", "-qcap", "4096", "-fpcap", "16384"]
+
+
+def test_cli_clean_run_exit0_and_counts(model_dir, capsys):
+    rc = main(["check", str(model_dir / "MC.cfg"), "-noTool"] + SMALL)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "17020" in out and "8203" in out  # FF corner final counts
+    assert "Model checking completed. No error has been found" in out
+
+
+def test_cli_tool_mode_framing(model_dir, capsys):
+    rc = main(["check", str(model_dir / "MC.cfg")] + SMALL)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "@!@!@STARTMSG 2193" in out  # success + collision estimate
+    assert "@!@!@STARTMSG 2199" in out  # final counts
+    assert "@!@!@ENDMSG" in out
+
+
+def test_cli_violation_exit12_and_trace(model_dir, capsys):
+    rc = main(
+        ["check", str(model_dir / "MC.cfg"), "-noTool", "-mutation",
+         "delete_noop"] + SMALL
+    )
+    out = capsys.readouterr().out
+    assert rc == 12
+    assert "assert" in out.lower()
+    # a trace of TLA-syntax states with PlusCal action labels
+    assert "/\\ apiState" in out
+    assert "State 1" in out
+
+
+def test_cli_checkpoint_and_recover(model_dir, tmp_path, capsys):
+    ck = str(tmp_path / "run.ckpt.npz")
+    rc = main(
+        ["check", str(model_dir / "MC.cfg"), "-noTool", "-checkpoint", ck,
+         "-checkpointevery", "16"] + SMALL
+    )
+    capsys.readouterr()
+    assert rc == 0
+    assert os.path.exists(ck)
+    # recover from the final checkpoint: immediately complete, same verdict
+    rc = main(
+        ["check", str(model_dir / "MC.cfg"), "-noTool", "-checkpoint", ck,
+         "-recover"] + SMALL
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "17020" in out and "8203" in out
