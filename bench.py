@@ -42,13 +42,17 @@ def main() -> int:
     from jaxtlc.engine.bfs import check
 
     if scaled:
+        # segmented execution (one fused 64-chunk dispatch per host sync):
+        # multi-minute single dispatches can hit device-runtime limits
+        from jaxtlc.engine.checkpoint import check_with_checkpoints
+
         cfg, kwargs = scaled_config()
+        r = check_with_checkpoints(cfg, ckpt_every=64, **kwargs)
     else:
         cfg, kwargs = MODEL_1, dict(
             chunk=1024, queue_capacity=1 << 15, fp_capacity=1 << 20
         )
-
-    r = check(cfg, **kwargs)
+        r = check(cfg, **kwargs)
     fail = None
     if r.violation:
         fail = r.violation_name

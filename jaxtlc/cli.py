@@ -111,6 +111,36 @@ def _run_check(args) -> int:
     )
 
     violated = r.violation != 0
+    liveness_violated = False
+    if not violated and (args.liveness or spec.properties):
+        from .engine.liveness import build_graph, check_properties
+        from .spec.codec import get_codec
+        from .spec.pretty import state_to_tla
+
+        props = spec.properties or ["ReconcileCompletes", "CleansUpProperly"]
+        graph = build_graph(spec.model, chunk=args.chunk)
+        results = check_properties(
+            spec.model, props, graph=graph,
+            fairness=args.fairness,
+        )
+        decode = get_codec(spec.model).decode
+        for res in results:
+            if res.holds:
+                log.msg(1000, f"Temporal property {res.name} holds "
+                              f"(fairness: {args.fairness}).")
+                continue
+            liveness_violated = True
+            log.msg(2116, f"Temporal properties were violated: {res.name} "
+                          f"(fairness: {args.fairness})", severity=1)
+            idx = 1
+            for enc, act in zip(res.prefix, res.prefix_actions):
+                log.trace_state(idx, act, state_to_tla(decode(enc), spec.model))
+                idx += 1
+            log.msg(1000, "-- The following states form a cycle "
+                          "(back to the first of them) --")
+            for enc, act in zip(res.cycle, res.cycle_actions):
+                log.trace_state(idx, act, state_to_tla(decode(enc), spec.model))
+                idx += 1
     if violated:
         if r.violation == VIOL_TYPEOK and "TypeOK" in spec.invariants:
             log.invariant_violated("TypeOK")
@@ -125,7 +155,7 @@ def _run_check(args) -> int:
         else:
             log.msg(1000, f"Run stopped: {r.violation_name}", severity=1)
         _print_trace(log, spec.model, args.chunk)
-    else:
+    elif not liveness_violated:
         log.success(r.distinct)
         log.coverage(2, r.action_generated, r.action_distinct)
 
@@ -135,7 +165,9 @@ def _run_check(args) -> int:
     avg = round(r.generated / max(1, r.distinct))
     log.outdegree(avg, 0, 4)
     log.finished(int((time.time() - t0) * 1000))
-    return 12 if violated else 0
+    if violated:
+        return 12
+    return 13 if liveness_violated else 0  # TLC liveness exit convention
 
 
 def _print_trace(log: TLCLog, model: ModelConfig, chunk: int) -> None:
@@ -171,6 +203,13 @@ def main(argv=None) -> int:
                    help="chunks between checkpoints")
     c.add_argument("-recover", action="store_true",
                    help="resume from -checkpoint PATH (TLC -recover analog)")
+    c.add_argument("-liveness", action="store_true",
+                   help="check the declared temporal properties even when "
+                        "the launch config disables them (E8)")
+    c.add_argument("-fairness", default="wf_next",
+                   choices=["wf_next", "wf_process"],
+                   help="wf_next = the spec's literal WF_vars(Next); "
+                        "wf_process = per-process weak fairness")
     c.add_argument("-nodeadlock", action="store_true")
     c.add_argument("-noTool", action="store_true",
                    help="plain text output (no @!@!@ framing)")

@@ -116,8 +116,9 @@ def check_with_checkpoints(
     def segment(c: EngineCarry) -> EngineCarry:
         return lax.fori_loop(0, ckpt_every, lambda _, cc: step_fn(cc), c)
 
-    t0 = time.time()
     template = init_fn()
+    compiled_segment = segment.lower(template).compile()
+    t0 = time.time()
     if resume:
         if ckpt_path is None or not os.path.exists(ckpt_path):
             raise FileNotFoundError(f"no checkpoint at {ckpt_path!r}")
@@ -144,7 +145,7 @@ def check_with_checkpoints(
             break
         if max_segments is not None and segments >= max_segments:
             break
-        carry = jax.block_until_ready(segment(carry))
+        carry = jax.block_until_ready(compiled_segment(carry))
         segments += 1
         if ckpt_path is not None:
             save_checkpoint(ckpt_path, carry, meta)

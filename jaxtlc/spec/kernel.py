@@ -55,14 +55,23 @@ class Lane(Tuple):
     pass
 
 
+def lane_layout(cfg: ModelConfig) -> Tuple[int, int]:
+    """(CL, L): client lane-block width and total lane count.  Lane l acts
+    for process l // CL when l < nc*CL, else the server.  Single source of
+    truth for anything (e.g. the liveness graph builder) that must map
+    lanes back to acting processes."""
+    cdc = get_codec(cfg)
+    CL = max(3, cdc.ls)
+    return CL, cdc.nc * CL + 2 * cdc.nc
+
+
 def make_kernel(cfg: ModelConfig):
     """Build ``step(vec[F]) -> (succ[L,F], valid[L], action[L], afail[L],
     overflow[L])`` for one config.  All loops below are over static python
     ints and unroll at trace time."""
     cdc = get_codec(cfg)
     ni, nc, ls = cdc.ni, cdc.nc, cdc.ls
-    CL = max(3, ls)
-    L = nc * CL + 2 * nc
+    CL, L = lane_layout(cfg)
 
     fail = bool(cfg.requests_can_fail)
     timeout = bool(cfg.requests_can_timeout)

@@ -166,6 +166,7 @@ class Succ(NamedTuple):
     label: str  # action label that produced this successor
     state: State
     violation: Optional[str]  # assert-failure id, else None
+    proc: int = -1  # acting process index (n_clients = the server)
 
 
 def _ckey(v):
@@ -208,8 +209,10 @@ def successors(st: State, cfg: ModelConfig) -> List[Succ]:
     """
     out: List[Succ] = []
     fail, timeout = cfg.requests_can_fail, cfg.requests_can_timeout
+    proc_bounds: List[int] = []  # len(out) after each client's block
 
     for i, self in enumerate(cfg.clients):
+        proc_bounds.append(len(out))
         lbl = st.pc[i]
         is_recon = cfg.roles[i] == RECONCILER
         if is_recon:
@@ -392,8 +395,19 @@ def successors(st: State, cfg: ModelConfig) -> List[Succ]:
         else:  # pragma: no cover
             raise AssertionError(f"unknown label {lbl!r}")
 
+    proc_bounds.append(len(out))  # start of the server block
     out.extend(_server_lanes(st, cfg))
-    return out
+    # tag each successor with its acting process (client index or server):
+    # client i's block is [proc_bounds[i], proc_bounds[i+1])
+    tagged: List[Succ] = []
+    for p in range(len(cfg.clients)):
+        tagged.extend(
+            s._replace(proc=p) for s in out[proc_bounds[p] : proc_bounds[p + 1]]
+        )
+    tagged.extend(
+        s._replace(proc=cfg.n_clients) for s in out[proc_bounds[-1] :]
+    )
+    return tagged
 
 
 def _server_lanes(st: State, cfg: ModelConfig) -> List[Succ]:

@@ -71,6 +71,19 @@ def test_cli_violation_exit12_and_trace(model_dir, capsys):
     assert "State 1" in out
 
 
+def test_cli_liveness_exit13_and_lasso(model_dir, capsys):
+    rc = main(
+        ["check", str(model_dir / "MC.cfg"), "-noTool", "-liveness"] + SMALL
+    )
+    out = capsys.readouterr().out
+    assert rc == 13  # TLC liveness-violation exit convention
+    assert "Temporal properties were violated" in out
+    assert "form a cycle" in out
+    assert "/\\ apiState" in out
+    # a liveness-violating run must not also claim success
+    assert "No error has been found" not in out
+
+
 def test_cli_checkpoint_and_recover(model_dir, tmp_path, capsys):
     ck = str(tmp_path / "run.ckpt.npz")
     rc = main(

