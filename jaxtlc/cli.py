@@ -162,8 +162,8 @@ def _run_check(args) -> int:
     log.progress(r.depth, r.generated, r.distinct, r.queue_left)
     log.final_counts(r.generated, r.distinct, r.queue_left)
     log.depth(r.depth)
-    avg = round(r.generated / max(1, r.distinct))
-    log.outdegree(avg, 0, 4)
+    if r.outdegree is not None:
+        log.outdegree(*r.outdegree)
     log.finished(int((time.time() - t0) * 1000))
     if violated:
         return 12

@@ -76,6 +76,8 @@ class EngineCarry(NamedTuple):
     distinct: jnp.ndarray  # uint32
     act_gen: jnp.ndarray  # [n_labels + 1] uint32
     act_dist: jnp.ndarray  # [n_labels + 1] uint32
+    outdeg_hist: jnp.ndarray  # [L + 2] uint32: #popped states with d new
+    # children (TLC's outdegree, MC.out:1104); last row = scatter dump
     viol: jnp.ndarray  # int32 code
     viol_state: jnp.ndarray  # [F] int32
     viol_action: jnp.ndarray  # int32
@@ -94,6 +96,9 @@ class CheckResult(NamedTuple):
     action_distinct: dict
     wall_s: float
     iterations: int
+    # (avg, min, max, p95) of TLC's outdegree = distinct new states per
+    # expanded state (matches MC.out:1104); None when not tracked (sharded)
+    outdegree: tuple = None
 
 
 def make_engine(
@@ -142,6 +147,7 @@ def make_engine(
             distinct=distinct0,
             act_gen=jnp.zeros(n_labels + 1, jnp.uint32),
             act_dist=jnp.zeros(n_labels + 1, jnp.uint32),
+            outdeg_hist=jnp.zeros(L + 2, jnp.uint32),
             viol=jnp.int32(OK),
             viol_state=jnp.zeros(F, jnp.int32),
             viol_action=jnp.int32(-1),
@@ -191,6 +197,9 @@ def make_engine(
         distinct = c.distinct + n_new.astype(jnp.uint32)
         act_gen = c.act_gen.at[jnp.where(fvalid, faction, n_labels)].add(1)
         act_dist = c.act_dist.at[jnp.where(is_new, faction, n_labels)].add(1)
+        # TLC outdegree = distinct new successors per expanded state
+        newdeg = is_new.reshape(chunk, L).sum(axis=1)
+        outdeg_hist = c.outdeg_hist.at[jnp.where(mask, newdeg, L + 1)].add(1)
 
         # violations (first wins; priority: invariant > assert > deadlock >
         # capacity).  Capture the offending state: candidate for invariants,
@@ -242,6 +251,7 @@ def make_engine(
             distinct=distinct,
             act_gen=act_gen,
             act_dist=act_dist,
+            outdeg_hist=outdeg_hist,
             viol=viol,
             viol_state=viol_state,
             viol_action=viol_action,
@@ -292,6 +302,20 @@ def result_from_carry(
     """Pull a finished (or interrupted) carry to host as a CheckResult."""
     act_gen = np.asarray(carry.act_gen)[: len(LABELS)]
     act_dist = np.asarray(carry.act_dist)[: len(LABELS)]
+    hist = np.asarray(carry.outdeg_hist)[:-1].astype(np.int64)  # drop dump
+    outdegree = None
+    if hist.sum() > 0:
+        degs = np.arange(len(hist))
+        total = hist.sum()
+        nz = np.flatnonzero(hist)
+        cum = np.cumsum(hist)
+        p95 = int(degs[np.searchsorted(cum, 0.95 * total)])
+        outdegree = (
+            int(round((degs * hist).sum() / total)),
+            int(nz[0]),
+            int(nz[-1]),
+            p95,
+        )
     return CheckResult(
         generated=int(carry.generated),
         distinct=int(carry.distinct),
@@ -309,4 +333,5 @@ def result_from_carry(
         },
         wall_s=wall_s,
         iterations=iterations,
+        outdegree=outdegree,
     )

@@ -145,11 +145,13 @@ class TLCLog:
     def depth(self, d: int) -> None:
         self.msg(2194, f"The depth of the complete state graph search is {d}.")
 
-    def outdegree(self, avg: int, mn: int, mx: int) -> None:
+    def outdegree(self, avg: int, mn: int, mx: int, p95: int) -> None:
+        # format matches MC.out:1104 byte for byte
         self.msg(
             2268,
             f"The average outdegree of the complete state graph is {avg} "
-            f"(minimum is {mn}, the maximum {mx}).",
+            f"(minimum is {mn}, the maximum {mx} and the 95th percentile is "
+            f"{p95}).",
         )
 
     def finished(self, ms: int) -> None:

@@ -20,6 +20,10 @@ def test_device_engine_ff_exact():
     assert (r.generated, r.distinct, r.depth) == (17020, 8203, 109)
     assert r.queue_left == 0
     assert r.violation == 0
+    # TLC-style outdegree (distinct new states per expansion); avg and p95
+    # are attribution-robust, min/max pin the engine's deterministic
+    # in-batch arbitration
+    assert r.outdegree == (1, 0, 2, 2)
 
 
 def test_host_driver_ff_exact_and_level_sets():
@@ -107,6 +111,7 @@ def test_device_engine_model1_exact_tlc_parity():
     r = check(MODEL_1, chunk=1024, queue_capacity=1 << 15, fp_capacity=1 << 20)
     assert (r.generated, r.distinct, r.depth) == (577736, 163408, 124)
     assert r.queue_left == 0 and r.violation == 0
+    assert r.outdegree == (1, 0, 4, 2)  # MC.out:1104 exactly
     # per-action coverage parity with MC.out:78,621
     assert r.action_generated["DoRequest"] == 149766
     assert r.action_generated["APIStart"] == 27059
