@@ -53,6 +53,13 @@ def _run_check(args) -> int:
             file=sys.stderr,
         )
         return 1
+    if args.fpset == "DiskFPSet" and (args.checkpoint or args.sharded):
+        print(
+            "Error: -fpset DiskFPSet is not supported with -checkpoint "
+            "or -sharded yet",
+            file=sys.stderr,
+        )
+        return 1
 
     log = TLCLog(tool_mode=not args.noTool)
     import jax
@@ -77,6 +84,14 @@ def _run_check(args) -> int:
             chunk=args.chunk,
             queue_capacity=args.qcap,
             fp_capacity=args.fpcap,
+        )
+    elif args.fpset == "DiskFPSet":
+        # the OffHeapDiskFPSet/DiskStateQueue analog: authoritative dedup +
+        # frontier in the native (C++, disk-bounded) host tier
+        from .engine.hybrid import check_hybrid
+
+        r = check_hybrid(
+            spec.model, chunk=args.chunk, fp_index=spec.fp_index
         )
     elif args.checkpoint:
         from .engine.checkpoint import check_with_checkpoints
@@ -189,7 +204,11 @@ def main(argv=None) -> int:
     c = sub.add_parser("check", help="exhaustively check a TLC model config")
     c.add_argument("config", help="path to MC.cfg (sibling MC.tla is read)")
     c.add_argument("-workers", default="tpu", help="TLC contract knob")
-    c.add_argument("-fpset", default="JaxFPSet", help="TLC contract knob")
+    c.add_argument("-fpset", default="JaxFPSet",
+                   choices=["JaxFPSet", "DiskFPSet"],
+                   help="JaxFPSet = device-resident fingerprint table; "
+                        "DiskFPSet = native host tier (disk-bounded, the "
+                        "OffHeapDiskFPSet analog)")
     c.add_argument("-fp", type=int, default=None, help="fp polynomial index")
     c.add_argument("-sharded", type=int, default=0, metavar="N",
                    help="run the sharded engine over N devices")

@@ -186,4 +186,7 @@ def scaled_config():
     """
     cfg = make_scaled(n_reconcilers=2, n_binders=1, requests_can_fail=False,
                       requests_can_timeout=False)
-    return cfg, dict(chunk=4096, queue_capacity=1 << 21, fp_capacity=1 << 25)
+    # fp_capacity 4x the state count: the lockstep batched probe pays for
+    # the WORST probe chain in the batch, so load factor is kept below
+    # ~30% (measured on-chip: 59k states/s at 0.58 load vs 87k/s at 0.29)
+    return cfg, dict(chunk=4096, queue_capacity=1 << 21, fp_capacity=1 << 26)

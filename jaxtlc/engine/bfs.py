@@ -296,6 +296,26 @@ def check(
     return result_from_carry(carry, wall)
 
 
+def outdegree_from_hist(hist: np.ndarray):
+    """(avg, min, max, p95) of TLC's outdegree from a new-children
+    histogram (hist[d] = #expanded states with d new successors); None if
+    empty.  Matches MC.out:1104's reporting convention."""
+    hist = np.asarray(hist, dtype=np.int64)
+    total = hist.sum()
+    if total == 0:
+        return None
+    degs = np.arange(len(hist))
+    nz = np.flatnonzero(hist)
+    cum = np.cumsum(hist)
+    p95 = int(degs[np.searchsorted(cum, 0.95 * total)])
+    return (
+        int(round((degs * hist).sum() / total)),
+        int(nz[0]),
+        int(nz[-1]),
+        p95,
+    )
+
+
 def result_from_carry(
     carry: EngineCarry, wall_s: float, iterations: int = -1
 ) -> CheckResult:
@@ -303,19 +323,7 @@ def result_from_carry(
     act_gen = np.asarray(carry.act_gen)[: len(LABELS)]
     act_dist = np.asarray(carry.act_dist)[: len(LABELS)]
     hist = np.asarray(carry.outdeg_hist)[:-1].astype(np.int64)  # drop dump
-    outdegree = None
-    if hist.sum() > 0:
-        degs = np.arange(len(hist))
-        total = hist.sum()
-        nz = np.flatnonzero(hist)
-        cum = np.cumsum(hist)
-        p95 = int(degs[np.searchsorted(cum, 0.95 * total)])
-        outdegree = (
-            int(round((degs * hist).sum() / total)),
-            int(nz[0]),
-            int(nz[-1]),
-            p95,
-        )
+    outdegree = outdegree_from_hist(hist)
     return CheckResult(
         generated=int(carry.generated),
         distinct=int(carry.distinct),

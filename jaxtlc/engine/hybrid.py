@@ -1,0 +1,192 @@
+"""Hybrid engine: device expansion + native host dedup/frontier tier.
+
+The TLC architecture at full scale: workers expand states while the
+fingerprint set and state queue live in off-heap/disk structures
+(OffHeapDiskFPSet + DiskStateQueue, MC.out:5), bounding the exhaustive run
+by disk rather than RAM.  TPU translation: the *device* does what it is
+good at - vmapped successor expansion, invariant predicates, canonical
+ordering, fingerprinting - in fixed-size chunks, while the *authoritative*
+fingerprint set and the frontier FIFO live in the native C++ tier
+(jaxtlc.native: mmap-backed open addressing + file-backed queue), whose
+capacity is the disk.
+
+This is the capacity mode: slower per state than the fully device-resident
+engine (every chunk round-trips candidates to the host), but the state
+space no longer has to fit in HBM - the "long-context analog: frontier
+spill/compaction" subsystem of SURVEY.md §5.  Exactness contract:
+identical generated/distinct/depth counts and outdegree avg/p95 as the
+device engine (differentially tested in tests/test_hybrid.py); outdegree
+min/max may differ because sequential (first-lane) in-batch attribution of
+a duplicate discovery legitimately differs from the device engine's
+scatter arbitration.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..native import HostFPStore, HostStateQueue
+from ..spec.codec import get_codec
+from ..spec.invariants import make_invariant_kernel
+from ..spec.kernel import initial_vectors, make_kernel
+from ..spec.labels import LABELS
+from .bfs import (
+    OK,
+    VIOL_ASSERT,
+    VIOL_DEADLOCK,
+    VIOL_ONLYONEVERSION,
+    VIOL_SLOT_OVERFLOW,
+    VIOL_TYPEOK,
+    VIOLATION_NAMES,
+    CheckResult,
+    outdegree_from_hist,
+)
+from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED, fp64_words
+
+
+def check_hybrid(
+    cfg: ModelConfig,
+    chunk: int = 1024,
+    fp_index: int = DEFAULT_FP_INDEX,
+    seed: int = DEFAULT_SEED,
+    fp_path: Optional[str] = None,
+    queue_path: Optional[str] = None,
+    initial_fp_capacity: int = 1 << 20,
+) -> CheckResult:
+    """Exhaustive check with host-resident (disk-bounded) dedup + frontier."""
+    cdc = get_codec(cfg)
+    F = cdc.n_fields
+    step = make_kernel(cfg)
+    L = step.n_lanes
+    inv_check = make_invariant_kernel(cfg)
+
+    @jax.jit
+    def expand(batch):
+        succs, valid, action, afail, ovf = jax.vmap(step)(batch)
+        flat = succs.reshape(chunk * L, F)
+        inv = jax.vmap(inv_check)(flat)
+        packed = cdc.pack(flat)
+        lo, hi = fp64_words(packed, cdc.nbits, fp_index, seed)
+        return flat, lo, hi, valid, action, afail, ovf, inv
+
+    t0 = time.time()
+    fps = HostFPStore(fp_path, initial_capacity=initial_fp_capacity)
+    queue = HostStateQueue(F, queue_path)
+    try:
+        inits = initial_vectors(cfg)
+        packed0 = cdc.pack(jnp.asarray(inits))
+        lo0, hi0 = fp64_words(packed0, cdc.nbits, fp_index, seed)
+        new0 = fps.insert(
+            np.asarray(lo0), np.asarray(hi0), np.ones(len(inits), bool)
+        )
+        queue.push(inits[new0])
+        generated = len(inits)
+
+        level = 1
+        depth = 1
+        level_left = int(new0.sum())  # records remaining in current level
+        next_level = 0  # records pushed for the next level
+        act_gen: dict = {}
+        act_dist: dict = {}
+        outdeg_hist = np.zeros(L + 1, dtype=np.int64)
+        viol = OK
+        viol_state = np.zeros(F, np.int32)
+        viol_action = -1
+        pad = np.zeros((chunk, F), dtype=np.int32)
+
+        while len(queue) and viol == OK:
+            n = min(chunk, level_left)
+            batch_np = queue.pop(n)
+            n = batch_np.shape[0]
+            buf = pad.copy()
+            buf[:n] = batch_np
+            flat, lo, hi, valid, action, afail, ovf, inv = map(
+                np.asarray, expand(jnp.asarray(buf))
+            )
+            valid = valid.copy()
+            valid[n:] = False
+            fvalid = valid.reshape(-1)
+            afail = afail & valid
+            ovf = ovf & valid
+            dead = valid[:n].sum(axis=1) == 0
+            generated += int(fvalid.sum())
+
+            is_new = fps.insert(lo, hi, fvalid)
+            new_flat = flat[is_new]
+            queue.push(new_flat)
+
+            faction = action.reshape(-1)
+            for a in faction[fvalid]:
+                act_gen[int(a)] = act_gen.get(int(a), 0) + 1
+            for a in faction[is_new]:
+                act_dist[int(a)] = act_dist.get(int(a), 0) + 1
+            newdeg = is_new.reshape(chunk, L).sum(axis=1)
+            np.add.at(outdeg_hist, newdeg[:n], 1)
+
+            # violations, same priority order as the device engine
+            bad_type = fvalid & ((inv & 1) == 0)
+            bad_oov = fvalid & ((inv & 2) == 0)
+            for code, vmask, states, acts in (
+                (VIOL_TYPEOK, bad_type, flat, faction),
+                (VIOL_ONLYONEVERSION, bad_oov, flat, faction),
+                (
+                    VIOL_ASSERT,
+                    afail.reshape(-1),
+                    np.repeat(buf, L, axis=0),
+                    faction,
+                ),
+                (VIOL_DEADLOCK, dead, buf, None),
+                (
+                    VIOL_SLOT_OVERFLOW,
+                    ovf.reshape(-1),
+                    np.repeat(buf, L, axis=0),
+                    faction,
+                ),
+            ):
+                if viol == OK and vmask.any():
+                    viol = code
+                    i = int(np.argmax(vmask))
+                    viol_state = states[i]
+                    viol_action = int(acts[i]) if acts is not None else -1
+
+            level_left -= n
+            next_level += int(is_new.sum())
+            if level_left == 0:
+                level_left = next_level
+                next_level = 0
+                if level_left:
+                    level += 1
+                    depth = level
+
+        distinct = len(fps)
+        queue_left = len(queue)
+        fps.sync()
+    finally:
+        fps.close()
+        queue.close()
+
+    return CheckResult(
+        generated=generated,
+        distinct=distinct,
+        depth=depth,
+        queue_left=queue_left,
+        violation=viol,
+        violation_name=VIOLATION_NAMES[viol],
+        violation_state=viol_state,
+        violation_action=viol_action,
+        action_generated={
+            LABELS[k]: v for k, v in sorted(act_gen.items())
+        },
+        action_distinct={
+            LABELS[k]: v for k, v in sorted(act_dist.items())
+        },
+        wall_s=time.time() - t0,
+        iterations=-1,
+        outdegree=outdegree_from_hist(outdeg_hist),
+    )

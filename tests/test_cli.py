@@ -71,6 +71,16 @@ def test_cli_violation_exit12_and_trace(model_dir, capsys):
     assert "State 1" in out
 
 
+def test_cli_disk_fpset_engine(model_dir, capsys):
+    rc = main(
+        ["check", str(model_dir / "MC.cfg"), "-noTool", "-fpset",
+         "DiskFPSet", "-chunk", "256"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "17020" in out and "8203" in out
+
+
 def test_cli_liveness_exit13_and_lasso(model_dir, capsys):
     rc = main(
         ["check", str(model_dir / "MC.cfg"), "-noTool", "-liveness"] + SMALL
