@@ -129,10 +129,12 @@ class TLCLog:
             d = act_dist.get(name, 0)
             if g == 0 and d == 0:
                 continue
+            # span matches the reference label token (col len+6, cf. the
+            # committed MC.out action lines); code 2772 = action coverage
             self.msg(
-                2773,
+                2772,
                 f"<{name} line {line}, col 1 to line {line}, "
-                f"col {len(name)} of module KubeAPI>: {d}:{g}",
+                f"col {len(name) + 6} of module KubeAPI>: {d}:{g}",
             )
 
     def final_counts(self, generated: int, distinct: int, queue: int) -> None:

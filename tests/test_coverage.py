@@ -1,0 +1,55 @@
+"""Per-action coverage parity with the committed TLC run (E9).
+
+MC.out:44-1092 reports, for every action, `distinct:generated` - how many
+successor enumerations the action contributed and how many of them were
+first discoveries.  `generated` per action is attribution-free (every
+enumeration counts), so it must match MC.out EXACTLY; `distinct` per action
+depends on which of several same-level discoverers gets credit (TLC's own
+numbers are worker-interleaving artifacts), so we assert the
+attribution-free invariants: per-action distinct sums to total distinct
+minus the initial states, and each action's distinct never exceeds MC.out's
+generated for it.
+"""
+
+import re
+
+import pytest
+
+from jaxtlc.config import MODEL_1
+from jaxtlc.engine.bfs import check
+
+MC_OUT = "/root/reference/KubeAPI.toolbox/Model_1/MC.out"
+_ACTION = re.compile(r"^<(\w+) line \d+.*>: (\d+):(\d+)$")
+
+
+def reference_action_coverage():
+    """{action: (distinct, generated)} parsed from the committed MC.out."""
+    out = {}
+    with open(MC_OUT, "r", encoding="utf-8") as f:
+        for line in f:
+            m = _ACTION.match(line.strip())
+            if m:
+                out[m.group(1)] = (int(m.group(2)), int(m.group(3)))
+    return out
+
+
+def test_mc_out_parses():
+    ref = reference_action_coverage()
+    assert ref["Init"] == (2, 2)
+    assert ref["DoRequest"] == (19655, 149766)  # MC.out:78
+    assert ref["APIStart"] == (18152, 27059)  # MC.out:621
+    assert len(ref) == 24  # Init + 23 actions
+
+
+@pytest.mark.slow
+def test_model1_per_action_generated_matches_mc_out():
+    ref = reference_action_coverage()
+    r = check(MODEL_1, chunk=1024, queue_capacity=1 << 15, fp_capacity=1 << 20)
+    for name, (d_ref, g_ref) in ref.items():
+        if name == "Init":
+            continue
+        assert r.action_generated.get(name, 0) == g_ref, name
+    # attribution-free distinct invariants
+    assert sum(r.action_distinct.values()) == 163408 - 2
+    for name, d in r.action_distinct.items():
+        assert d <= ref[name][1], name
