@@ -24,6 +24,8 @@ Usage:
 
 import json
 import sys
+import time
+import traceback
 
 TLC_DISTINCT_PER_S = 163408 / 9.875  # = 16547/s, MC.out:1098,1107
 EXPECT = {
@@ -33,9 +35,63 @@ EXPECT = {
 }
 
 
+def _emit(payload: dict) -> None:
+    """The contract: exactly one JSON line on stdout, on EVERY exit path."""
+    base = {
+        "metric": "distinct_states_per_s",
+        "value": 0,
+        "unit": "states/s",
+        "vs_baseline": 0,
+    }
+    base.update(payload)
+    print(json.dumps(base), flush=True)
+
+
+def _probe_backend(attempts: int = 2, hang_timeout_s: int = 120) -> str:
+    """Probe the default jax backend in a KILLABLE subprocess.
+
+    The tunneled TPU backend has failed both ways across rounds: raising
+    ('Unable to initialize backend', BENCH_r02) and hanging forever inside
+    PJRT C++ where no Python signal can interrupt it.  Probing in a child
+    process converts both into a clean verdict.  Returns "" on success or
+    the failure description; on failure the caller falls back to the
+    forced-CPU platform so a real (if slower) measurement still exists.
+    """
+    import subprocess
+
+    err = "unknown"
+    delay = 5.0
+    for i in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=hang_timeout_s,
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode == 0:
+                return ""
+            err = (proc.stderr.strip().splitlines() or ["rc!=0"])[-1]
+        except subprocess.TimeoutExpired:
+            err = f"backend init hung > {hang_timeout_s}s"
+        if i < attempts - 1:
+            time.sleep(delay)
+            delay *= 2
+    return err
+
+
 def main() -> int:
     scaled = "--scaled" in sys.argv
     workload = "scaled" if scaled else "Model_1"
+    device_note = ""
+    probe_err = _probe_backend()
+    if probe_err:
+        # TPU unreachable: measure on the forced-CPU platform rather than
+        # report nothing (the JSON records the downgrade explicitly)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        device_note = f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
     import jax
 
     from jaxtlc.config import MODEL_1, scaled_config
@@ -62,38 +118,32 @@ def main() -> int:
             f" != {EXPECT[workload]}"
         )
     if fail:
-        print(
-            json.dumps(
-                {
-                    "metric": "distinct_states_per_s",
-                    "value": 0,
-                    "unit": "states/s",
-                    "vs_baseline": 0,
-                    "error": fail,
-                }
-            )
-        )
+        _emit({"error": fail, "workload": workload})
         return 1
 
     rate = r.distinct / r.wall_s
-    print(
-        json.dumps(
-            {
-                "metric": "distinct_states_per_s",
-                "value": round(rate, 1),
-                "unit": "states/s",
-                "vs_baseline": round(rate / TLC_DISTINCT_PER_S, 2),
-                "workload": workload,
-                "generated": r.generated,
-                "distinct": r.distinct,
-                "depth": r.depth,
-                "wall_s": round(r.wall_s, 3),
-                "device": str(jax.devices()[0]),
-            }
-        )
+    _emit(
+        {
+            "value": round(rate, 1),
+            "vs_baseline": round(rate / TLC_DISTINCT_PER_S, 2),
+            "workload": workload,
+            "generated": r.generated,
+            "distinct": r.distinct,
+            "depth": r.depth,
+            "wall_s": round(r.wall_s, 3),
+            "device": str(jax.devices()[0]) + device_note,
+        }
     )
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        rc = main()
+    except BaseException as e:  # noqa: BLE001 - contract: always emit JSON
+        if isinstance(e, KeyboardInterrupt):
+            raise
+        traceback.print_exc(file=sys.stderr)
+        _emit({"error": f"{type(e).__name__}: {e}"})
+        rc = 1
+    sys.exit(rc)
