@@ -77,6 +77,11 @@ def load_checkpoint(path: str, template: EngineCarry):
                 f"checkpoint leaf shape {got.shape} != engine {want.shape} "
                 "- was the engine built with different capacities?"
             )
+        if got.dtype != np.asarray(want).dtype:
+            raise ValueError(
+                f"checkpoint leaf dtype {got.dtype} != engine "
+                f"{np.asarray(want).dtype} - corrupt or version-skewed file"
+            )
     return meta, jax.tree_util.tree_unflatten(treedef, leaves)
 
 

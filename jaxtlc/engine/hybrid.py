@@ -59,7 +59,12 @@ def check_hybrid(
     queue_path: Optional[str] = None,
     initial_fp_capacity: int = 1 << 20,
 ) -> CheckResult:
-    """Exhaustive check with host-resident (disk-bounded) dedup + frontier."""
+    """Exhaustive check with host-resident (disk-bounded) dedup + frontier.
+
+    A fresh check: HostFPStore is opened fresh (any fingerprint file left at
+    fp_path by a previous run is discarded - recovering it while the queue
+    is truncated would yield a bogus instantly-"complete" result).
+    """
     cdc = get_codec(cfg)
     F = cdc.n_fields
     step = make_kernel(cfg)

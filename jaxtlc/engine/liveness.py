@@ -388,15 +388,24 @@ def _check_leads_to(
     else:
         raise ValueError(f"unknown fairness mode {fairness!r}")
 
-    # materialize states + the action label that produced each transition
+    # materialize states + the action label that produced each transition.
+    # Edges are deduped per (src, dst, proc), so one (src, dst) pair can be
+    # reachable via several processes with different labels; prefer the BFS
+    # parent_action (exact for prefix steps), fall back to any real edge's
+    # label for walk steps (every candidate is a genuine transition of G).
     edge_action = {}
     for s, d, a in zip(g.src, g.dst, g.eaction):
         edge_action.setdefault((int(s), int(d)), LABELS[int(a)])
 
+    def step_label(p: int, i: int) -> Optional[str]:
+        if int(g.parent[i]) == p and int(g.parent_action[i]) >= 0:
+            return LABELS[int(g.parent_action[i])]
+        return edge_action.get((p, i))
+
     def acts(ids: List[int], pred0: Optional[int]) -> List[Optional[str]]:
         preds = [pred0] + ids[:-1]
         return [
-            None if p is None or p == i else edge_action.get((p, i))
+            None if p is None or p == i else step_label(p, i)
             for p, i in zip(preds, ids)
         ]
 

@@ -83,13 +83,25 @@ def lib() -> ctypes.CDLL:
 
 
 class HostFPStore:
-    """Disk-backed (mmap) authoritative fingerprint set."""
+    """Disk-backed (mmap) authoritative fingerprint set.
 
-    def __init__(self, path: str = None, initial_capacity: int = 1 << 20):
+    fresh=True (the default) removes any existing file at `path` first: a
+    store opened for a new run must start empty, or the recovered contents
+    silently dedup the new run's states away.  Pass fresh=False to recover
+    a previous run's set (the TLC -recover analog)."""
+
+    def __init__(
+        self,
+        path: str = None,
+        initial_capacity: int = 1 << 20,
+        fresh: bool = True,
+    ):
         self._own_tmp = path is None
         if path is None:
             fd, path = tempfile.mkstemp(suffix=".fps")
             os.close(fd)
+            os.unlink(path)
+        elif fresh and os.path.exists(path):
             os.unlink(path)
         self.path = path
         self._h = lib().fps_open(path.encode(), initial_capacity)

@@ -38,7 +38,7 @@ def test_mc_out_parses():
     assert ref["Init"] == (2, 2)
     assert ref["DoRequest"] == (19655, 149766)  # MC.out:78
     assert ref["APIStart"] == (18152, 27059)  # MC.out:621
-    assert len(ref) == 24  # Init + 23 actions
+    assert len(ref) == 23  # Init + 22 actions (13 Client + 4 PVC + 4 proc + 1 server)
 
 
 @pytest.mark.slow

@@ -39,11 +39,14 @@ def test_fpstore_persistence(tmp_path):
     s.insert(lo, hi, np.ones(100, bool))
     s.sync()
     s.close()
-    s2 = HostFPStore(p)
+    s2 = HostFPStore(p, fresh=False)  # the TLC -recover analog
     assert len(s2) == 100
     again = s2.insert(lo, hi, np.ones(100, bool))
     assert not again.any()  # everything already known after reopen
     s2.close()
+    # the default (fresh=True) must start empty even when the file exists
+    with HostFPStore(p) as s3:
+        assert len(s3) == 0
 
 
 def test_fpstore_zero_and_one_are_distinct(tmp_path):
