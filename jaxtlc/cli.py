@@ -42,16 +42,20 @@ def _run_check(args) -> int:
     except (ValueError, OSError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
+    from .frontend.model import KNOWN_PROPERTIES
+
+    unknown = [q for q in spec.properties if q not in KNOWN_PROPERTIES]
+    if unknown:
+        print(
+            f"Error: unknown PROPERTY {', '.join(unknown)} "
+            f"(supported: {', '.join(KNOWN_PROPERTIES)})",
+            file=sys.stderr,
+        )
+        return 1
     if args.mutation:
         spec.model = dataclasses.replace(spec.model, mutation=args.mutation)
     if args.recover and not args.checkpoint:
         print("Error: -recover requires -checkpoint PATH", file=sys.stderr)
-        return 1
-    if args.checkpoint and args.sharded:
-        print(
-            "Error: -checkpoint is not supported with -sharded yet",
-            file=sys.stderr,
-        )
         return 1
     if args.fpset == "DiskFPSet" and (args.checkpoint or args.sharded):
         print(
@@ -75,16 +79,33 @@ def _run_check(args) -> int:
         import numpy as np
         from jax.sharding import Mesh
 
-        from .engine.sharded import check_sharded
+        from .engine.sharded import (
+            check_sharded,
+            check_sharded_with_checkpoints,
+        )
 
         mesh = Mesh(np.array(jax.devices()[: args.sharded]), ("fp",))
-        r = check_sharded(
-            spec.model,
-            mesh,
-            chunk=args.chunk,
-            queue_capacity=args.qcap,
-            fp_capacity=args.fpcap,
-        )
+        if args.checkpoint:
+            r = check_sharded_with_checkpoints(
+                spec.model,
+                mesh,
+                chunk=args.chunk,
+                queue_capacity=args.qcap,
+                fp_capacity=args.fpcap,
+                route_factor=args.routefactor,
+                ckpt_path=args.checkpoint,
+                ckpt_every=args.checkpointevery,
+                resume=args.recover,
+            )
+        else:
+            r = check_sharded(
+                spec.model,
+                mesh,
+                chunk=args.chunk,
+                queue_capacity=args.qcap,
+                fp_capacity=args.fpcap,
+                route_factor=args.routefactor,
+            )
     elif args.fpset == "DiskFPSet":
         # the OffHeapDiskFPSet/DiskStateQueue analog: authoritative dedup +
         # frontier in the native (C++, disk-bounded) host tier
@@ -213,6 +234,10 @@ def main(argv=None) -> int:
     c.add_argument("-sharded", type=int, default=0, metavar="N",
                    help="run the sharded engine over N devices")
     c.add_argument("-chunk", type=int, default=1024)
+    c.add_argument("-routefactor", type=float, default=2.0,
+                   help="sharded all_to_all bucket size as a multiple of "
+                        "the mean per-owner candidate count (raise after "
+                        "a routing-bucket-overflow halt)")
     c.add_argument("-qcap", type=int, default=1 << 15)
     c.add_argument("-fpcap", type=int, default=1 << 20)
     c.add_argument("-checkpoint", default="", metavar="PATH",

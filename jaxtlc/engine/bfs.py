@@ -51,6 +51,7 @@ VIOL_DEADLOCK = 4
 VIOL_SLOT_OVERFLOW = 5
 VIOL_FPSET_FULL = 6
 VIOL_QUEUE_FULL = 7
+VIOL_ROUTE_OVERFLOW = 8
 
 VIOLATION_NAMES = {
     OK: "none",
@@ -61,6 +62,7 @@ VIOLATION_NAMES = {
     VIOL_SLOT_OVERFLOW: "Codec slot overflow (raise ModelConfig bounds)",
     VIOL_FPSET_FULL: "Fingerprint table full (raise fp_capacity)",
     VIOL_QUEUE_FULL: "Frontier queue full (raise queue_capacity)",
+    VIOL_ROUTE_OVERFLOW: "Routing bucket overflow (raise route_factor)",
 }
 
 

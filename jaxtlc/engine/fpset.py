@@ -3,22 +3,35 @@
 TLC stores every seen state's 64-bit fingerprint in an open-addressing
 off-heap table (`OffHeapDiskFPSet`, /root/reference/KubeAPI.toolbox/Model_1/
 MC.out:5); 72% of generated states are rejected here (MC.out:1098), making
-dedup the hot path.  This is the TPU-native equivalent: a linear-probing
-hash table of (lo, hi) uint32 fingerprint lanes living in device HBM,
-with batched insert-or-find implemented as two nested ``lax.while_loop``s:
+dedup the hot path.  This is the TPU-native v3 design: a single
+``[cap, 2] uint32`` table of (lo, hi) fingerprint rows in device HBM, row
+(0, 0) meaning empty.  A batched insert-or-find is ONE ``lax.while_loop``
+whose every round costs O(batch) - no O(capacity) work anywhere:
 
-* an inner *lockstep probe*: every candidate walks its probe chain until it
-  hits its own fingerprint (seen before) or an empty slot (insertion point);
-* an outer *scatter/verify* round: all insertion candidates scatter into
-  their proposed slots, a second scatter of candidate indices arbitrates
-  collisions (one winner per slot), and losers - including duplicate
-  fingerprints within the batch, which lose the arbitration and then *find*
-  their twin on the next probe - retry from the next slot.
+1. **In-batch sort-dedup first** (``lax.sort`` by (hi, lo)): exactly one
+   representative per distinct fingerprint probes the table, so the probing
+   batch never contains equal fingerprints.  This is what makes the
+   claim-by-write arbitration sound: a claimed slot re-reads as the claimer's
+   row iff the claimer won (equal rows could not be distinguished).
+2. **Triangular probing** (slot_k = home + k(k+1)/2 mod cap, a permutation of
+   a power-of-two table): kills the primary clustering that made linear
+   probing's worst batch chain - which the lockstep batched probe pays in
+   full - explode past ~50% load.
+3. **Claim-by-write-then-verify**: pending candidates that see an empty slot
+   scatter their whole (lo, hi) row into it (a single row scatter, so one
+   candidate's complete row wins per slot), then gather back; winners are
+   done (is_new), losers walk on - the slot now provably holds a foreign
+   fingerprint.  This relies on XLA lowering a duplicate-index scatter as
+   some sequential order of whole-row updates - true of the TPU and CPU
+   backends this engine targets (updates are whole update-windows), NOT of
+   backends that lower scatter to per-element atomics.  tests/test_fpset.py
+   exercises exactly this contention path, so a backend that tears rows
+   fails loudly there rather than silently here.
 
-Each outer round resolves at least one candidate, so termination is bounded;
-the driver keeps occupancy below ~60% so probe chains stay short.  No
-atomics, no host round-trips - pure XLA scatters/gathers, which is the
-idiomatic way to express concurrent hash insertion on TPU.
+Every round each pending candidate advances exactly one probe step, so the
+round count is the worst probe chain in the (deduped) batch; the engine
+keeps occupancy below ~85% so an empty slot always terminates a chain.
+No atomics, no host round-trips - pure XLA gathers/scatters.
 """
 
 from __future__ import annotations
@@ -27,22 +40,30 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
 class FPSet(NamedTuple):
-    occ: jnp.ndarray  # [cap] bool
-    lo: jnp.ndarray  # [cap] uint32
-    hi: jnp.ndarray  # [cap] uint32
+    table: jnp.ndarray  # [cap, 2] uint32 rows (lo, hi); (0, 0) = empty
 
 
 def fpset_new(cap: int) -> FPSet:
     assert cap & (cap - 1) == 0, "capacity must be a power of two"
-    return FPSet(
-        occ=jnp.zeros(cap, dtype=bool),
-        lo=jnp.zeros(cap, dtype=jnp.uint32),
-        hi=jnp.zeros(cap, dtype=jnp.uint32),
-    )
+    return FPSet(table=jnp.zeros((cap, 2), dtype=jnp.uint32))
+
+
+def fpset_count(s: FPSet) -> jnp.ndarray:
+    """Occupied-slot count (uint32)."""
+    return (s.table.any(axis=1)).sum().astype(jnp.uint32)
+
+
+def _remap(lo, hi):
+    """Reserve (0,0) as the empty marker: real fingerprint (0,0) becomes
+    (1,0).  Merges two fp classes with probability 2^-64 - the same risk
+    class as TLC's own fingerprint collisions (MC.out:39-42)."""
+    z = (lo == 0) & (hi == 0)
+    return jnp.where(z, jnp.uint32(1), lo), hi
 
 
 def _home_slot(lo, hi, cap: int):
@@ -60,62 +81,90 @@ def home_slot_host(lo: int, hi: int, cap: int) -> int:
     return h & (cap - 1)
 
 
+def host_insert(table: np.ndarray, lo: int, hi: int) -> bool:
+    """Insert-or-find one fingerprint in a host-side [cap, 2] numpy table,
+    walking the exact probe sequence the device uses.  Returns is_new."""
+    cap = table.shape[0]
+    if lo == 0 and hi == 0:
+        lo = 1
+    home = home_slot_host(lo, hi, cap)
+    k = 0
+    while True:
+        slot = (home + (k * (k + 1) // 2)) & (cap - 1)
+        r0, r1 = int(table[slot, 0]), int(table[slot, 1])
+        if r0 == lo and r1 == hi:
+            return False
+        if r0 == 0 and r1 == 0:
+            table[slot, 0] = lo
+            table[slot, 1] = hi
+            return True
+        k += 1
+
+
 def fpset_insert(s: FPSet, lo, hi, mask) -> Tuple[FPSet, jnp.ndarray]:
     """Insert-or-find a batch of fingerprints.
 
     lo/hi: [N] uint32 lanes; mask: [N] bool (candidates to consider).
     Returns (updated set, is_new [N] bool).  Duplicate fingerprints within
-    the batch yield exactly one is_new=True.  The caller must keep occupancy
-    + N below capacity (the engine checks before calling).
+    the batch yield exactly one is_new=True (the HIGHEST lane index - the
+    sort is stable, so attribution is deterministic and matches the v2
+    engine's scatter arbitration, keeping the committed outdegree
+    statistics - max 4 on Model_1, as TLC reports, MC.out:1104 - stable
+    across fpset generations).  The caller must keep occupancy + N below
+    capacity (the engine checks before calling).
     """
-    cap = s.occ.shape[0]
+    cap = s.table.shape[0]
     capm = cap - 1
     n = lo.shape[0]
-    cand_idx = jnp.arange(n, dtype=jnp.int32)
+    lo, hi = _remap(lo, hi)
 
-    def outer_cond(st):
-        _, _, _, _, pending, _ = st
+    # in-batch dedup: sort (invalid, hi, lo, lane) - validity is the
+    # leading key (NOT a sentinel fingerprint value, which a real
+    # fingerprint could equal), so invalid lanes segregate after all valid
+    # ones; the LAST of each run of equal keys is the representative, and
+    # only valid representatives probe.
+    inval = (~mask).astype(jnp.uint32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    s_inv, s_hi, s_lo, s_idx = lax.sort(
+        (inval, hi, lo, idx), num_keys=3, is_stable=True
+    )
+    last = jnp.concatenate(
+        [
+            (s_inv[1:] != s_inv[:-1])
+            | (s_hi[1:] != s_hi[:-1])
+            | (s_lo[1:] != s_lo[:-1]),
+            jnp.ones(1, bool),
+        ]
+    )
+    rep_sorted = mask[s_idx] & last
+    rep = jnp.zeros(n, bool).at[s_idx].set(rep_sorted)
+
+    home = _home_slot(lo, hi, cap)
+    rows = jnp.stack([lo, hi], axis=1)  # [n, 2]
+
+    def cond(st):
+        _, _, pending, _ = st
         return pending.any()
 
-    def outer_body(st):
-        occ, tlo, thi, slots, pending, is_new = st
-
-        def probe_cond(ps):
-            _, done = ps
-            return ~done.all()
-
-        def probe_body(ps):
-            sl, done = ps
-            o = occ[sl]
-            m = o & (tlo[sl] == lo) & (thi[sl] == hi)
-            stop = (~o) | m
-            return jnp.where(done | stop, sl, (sl + 1) & capm), done | stop
-
-        slots, _ = lax.while_loop(probe_cond, probe_body, (slots, ~pending))
-        o = occ[slots]
-        found = pending & o  # probe stopped on an occupied slot => match
-        try_ins = pending & ~o
-        tgt = jnp.where(try_ins, slots, cap)  # cap = dump row
-        owner = jnp.full(cap + 1, -1, jnp.int32).at[tgt].set(cand_idx)
-        won = try_ins & (owner[slots] == cand_idx)
-        wtgt = jnp.where(won, slots, cap)
-        occ = occ.at[wtgt].set(True, mode="drop")
-        tlo = tlo.at[wtgt].set(lo, mode="drop")
-        thi = thi.at[wtgt].set(hi, mode="drop")
+    def body(st):
+        table, k, pending, is_new = st
+        slot = (home + ((k * (k + 1)) >> 1)) & capm
+        row = table[slot]  # [n, 2]
+        hit_lo, hit_hi = row[:, 0], row[:, 1]
+        found = pending & (hit_lo == lo) & (hit_hi == hi)
+        empty = pending & (hit_lo == 0) & (hit_hi == 0)
+        # claim: scatter whole rows into empty slots; one complete row wins
+        # per slot (batch fps are unique, so re-reading our own row back
+        # means we won)
+        wtgt = jnp.where(empty, slot, cap)
+        table = table.at[wtgt].set(rows, mode="drop")
+        row2 = table[slot]
+        won = empty & (row2[:, 0] == lo) & (row2[:, 1] == hi)
         is_new = is_new | won
-        pending = pending & ~found & ~won
-        # Losers re-probe from the same slot: if the winner there was their
-        # twin fingerprint they must *find* it (not skip past it); if it is a
-        # foreign fingerprint the inner probe loop walks on by itself.
-        return occ, tlo, thi, slots, pending, is_new
+        pending = pending & ~(found | won)
+        k = jnp.where(pending, k + 1, k)
+        return table, k, pending, is_new
 
-    init = (
-        s.occ,
-        s.lo,
-        s.hi,
-        _home_slot(lo, hi, cap),
-        mask,
-        jnp.zeros_like(mask),
-    )
-    occ, tlo, thi, _, _, is_new = lax.while_loop(outer_cond, outer_body, init)
-    return FPSet(occ, tlo, thi), is_new
+    init = (s.table, jnp.zeros(n, jnp.int32), rep, jnp.zeros(n, bool))
+    table, _, _, is_new = lax.while_loop(cond, body, init)
+    return FPSet(table), is_new

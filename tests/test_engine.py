@@ -22,8 +22,8 @@ def test_device_engine_ff_exact():
     assert r.violation == 0
     # TLC-style outdegree (distinct new states per expansion); avg and p95
     # are attribution-robust, min/max pin the engine's deterministic
-    # in-batch arbitration (the hybrid engine's sequential attribution
-    # gives max 3, like the oracle)
+    # in-batch arbitration (the v3 fpset's highest-lane attribution - the
+    # hybrid engine's sequential attribution gives max 3, like the oracle)
     assert r.outdegree == (1, 0, 2, 2)
 
 

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from jaxtlc.engine.fpset import fpset_insert, fpset_new
+from jaxtlc.engine.fpset import fpset_count, fpset_insert, fpset_new
 
 
 def test_matches_python_set_with_duplicates():
@@ -24,7 +24,7 @@ def test_matches_python_set_with_duplicates():
         assert not is_new[~mask].any()
         total_new += int(is_new.sum())
         seen.update(int(v) for v, m in zip(vals, mask) if m)
-    assert int(np.asarray(s.occ).sum()) == len(seen) == total_new
+    assert int(fpset_count(s)) == len(seen) == total_new
 
 
 def test_in_batch_duplicates_yield_single_new():
@@ -38,13 +38,27 @@ def test_in_batch_duplicates_yield_single_new():
 
 
 def test_zero_fingerprint_is_representable():
-    # fp == (0, 0) must work: occupancy is a separate mask, not a sentinel
+    # fp == (0, 0) must work: it is remapped to (1, 0) behind the scenes
+    # (the (0,0) row means empty), so insert-then-find still holds
     s = fpset_new(1 << 8)
     z = jnp.zeros(1, jnp.uint32)
     s, new = fpset_insert(s, z, z, jnp.ones(1, bool))
     assert bool(np.asarray(new)[0])
     s, new = fpset_insert(s, z, z, jnp.ones(1, bool))
     assert not bool(np.asarray(new)[0])
+
+
+def test_all_ones_fingerprint_with_masked_lanes():
+    # regression: a valid fp of all-ones must not be conflated with
+    # masked-out lanes (the old sort keyed invalid lanes to 0xFFFFFFFF)
+    s = fpset_new(1 << 8)
+    ones = jnp.full(3, 0xFFFFFFFF, jnp.uint32)
+    mask = jnp.asarray([True, False, False])
+    s, new = fpset_insert(s, ones, ones, mask)
+    assert list(np.asarray(new)) == [True, False, False]
+    s, new = fpset_insert(s, ones, ones, jnp.ones(3, bool))
+    assert not np.asarray(new).any()
+    assert int(fpset_count(s)) == 1
 
 
 def test_high_load():
