@@ -32,7 +32,10 @@ from ..config import ModelConfig
 from .bfs import OK, CheckResult, EngineCarry, make_engine, result_from_carry
 from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
 
-FORMAT_VERSION = 1
+# v2: fingerprint-table layout changed from triangular avalanche-hash
+# probing to bucketized top-bits-of-hi (fpset v4); a v1 table's rows sit at
+# slots the v4 walk never visits, so version skew must be rejected loudly.
+FORMAT_VERSION = 2
 
 
 def _meta(cfg: ModelConfig, **engine_params) -> dict:
@@ -131,8 +134,8 @@ def check_with_checkpoints(
         # chunk (and checkpoint cadence) may legitimately change across a
         # resume; the config and every parameter that shapes the carry or
         # the fingerprint function must not.
-        for key in ("config", "queue_capacity", "fp_capacity", "fp_index",
-                    "seed"):
+        for key in ("format", "config", "queue_capacity", "fp_capacity",
+                    "fp_index", "seed"):
             if saved_meta.get(key) != meta[key]:
                 raise ValueError(
                     f"checkpoint {key} mismatch: "

@@ -3,35 +3,41 @@
 TLC stores every seen state's 64-bit fingerprint in an open-addressing
 off-heap table (`OffHeapDiskFPSet`, /root/reference/KubeAPI.toolbox/Model_1/
 MC.out:5); 72% of generated states are rejected here (MC.out:1098), making
-dedup the hot path.  This is the TPU-native v3 design: a single
-``[cap, 2] uint32`` table of (lo, hi) fingerprint rows in device HBM, row
-(0, 0) meaning empty.  A batched insert-or-find is ONE ``lax.while_loop``
-whose every round costs O(batch) - no O(capacity) work anywhere:
+dedup the hot path.  v4 design, driven by on-chip microbenchmarks
+(tools/microbench.py: random row gathers ~70ns, row scatters ~140ns, 245k
+4-lane sorts ~2.5ms): the cost model is *row operations*, so the structure
+minimizes them.
 
-1. **In-batch sort-dedup first** (``lax.sort`` by (hi, lo)): exactly one
-   representative per distinct fingerprint probes the table, so the probing
-   batch never contains equal fingerprints.  This is what makes the
-   claim-by-write arbitration sound: a claimed slot re-reads as the claimer's
-   row iff the claimer won (equal rows could not be distinguished).
-2. **Triangular probing** (slot_k = home + k(k+1)/2 mod cap, a permutation of
-   a power-of-two table): kills the primary clustering that made linear
-   probing's worst batch chain - which the lockstep batched probe pays in
-   full - explode past ~50% load.
-3. **Claim-by-write-then-verify**: pending candidates that see an empty slot
-   scatter their whole (lo, hi) row into it (a single row scatter, so one
-   candidate's complete row wins per slot), then gather back; winners are
-   done (is_new), losers walk on - the slot now provably holds a foreign
-   fingerprint.  This relies on XLA lowering a duplicate-index scatter as
-   some sequential order of whole-row updates - true of the TPU and CPU
-   backends this engine targets (updates are whole update-windows), NOT of
-   backends that lower scatter to per-element atomics.  tests/test_fpset.py
-   exercises exactly this contention path, so a backend that tears rows
-   fails loudly there rather than silently here.
+* **Bucketized table**: ``[cap, 2] uint32`` rows (lo, hi), (0, 0) = empty,
+  viewed as ``cap/8`` buckets of 8 slots.  A bucket's occupied slots are
+  always a prefix (inserts fill in order, nothing is ever deleted), and the
+  home bucket of a fingerprint is the top bits of ``hi`` - monotonic in
+  fingerprint sort order.
+* **Sort-compact, then probe only unique candidates**: one stable sort
+  groups duplicate fingerprints (invalid lanes segregate on a separate
+  leading key - NOT a sentinel value, which a real fingerprint could
+  equal); a second stable 1-key sort compacts the group representatives to
+  the front, so the probe phase touches O(unique) rows, not O(batch).
+* **Conflict-free claims**: because compacted candidates arrive sorted,
+  same-bucket claimants are adjacent runs; each claimant takes slot
+  ``occupancy + rank-in-run``, so round-0 insertions cannot collide - no
+  claim-verify round trip for the common case.
+* **Straggler path**: candidates whose home bucket is (or becomes) full
+  walk slots linearly from the bucket start with v3-style
+  claim-by-write-then-verify (scatter the whole row, gather back, winners
+  done).  This relies on XLA lowering a duplicate-index scatter as some
+  sequential order of whole-row updates - true of the TPU and CPU backends
+  this engine targets; tests/test_fpset.py's high-load test exercises the
+  path so a backend that tears rows fails loudly.
 
-Every round each pending candidate advances exactly one probe step, so the
-round count is the worst probe chain in the (deduped) batch; the engine
-keeps occupancy below ~85% so an empty slot always terminates a chain.
-No atomics, no host round-trips - pure XLA gathers/scatters.
+Lookup/insert invariant: a fingerprint lives in bucket ``b + j`` only if
+buckets ``b .. b+j-1`` are full; so a probe that sees its home bucket
+non-full and no match knows the fingerprint is absent.
+
+Exactness: duplicate fingerprints within a batch yield exactly one
+``is_new=True`` (the highest lane index - the dedup sort is stable), and
+the distinct count is exact; only fingerprint *collisions* (two states, one
+fp) merge classes, the same risk TLC reports (MC.out:39-42).
 """
 
 from __future__ import annotations
@@ -43,6 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+BUCKET = 8  # slots per bucket; 64-byte bucket rows gather in one access
+
 
 class FPSet(NamedTuple):
     table: jnp.ndarray  # [cap, 2] uint32 rows (lo, hi); (0, 0) = empty
@@ -50,6 +58,7 @@ class FPSet(NamedTuple):
 
 def fpset_new(cap: int) -> FPSet:
     assert cap & (cap - 1) == 0, "capacity must be a power of two"
+    assert cap >= BUCKET, f"capacity must be at least {BUCKET}"
     return FPSet(table=jnp.zeros((cap, 2), dtype=jnp.uint32))
 
 
@@ -66,31 +75,30 @@ def _remap(lo, hi):
     return jnp.where(z, jnp.uint32(1), lo), hi
 
 
-def _home_slot(lo, hi, cap: int):
-    h = (lo ^ (hi * jnp.uint32(0x9E3779B1))) * jnp.uint32(0x85EBCA6B)
-    h ^= h >> 15
-    return (h & jnp.uint32(cap - 1)).astype(jnp.int32)
+def _bucket_of(hi, nbuckets: int):
+    """Home bucket = top log2(nbuckets) bits of hi (monotonic in (hi, lo)
+    sort order - the property the conflict-free rank claims rely on)."""
+    lognb = nbuckets.bit_length() - 1
+    if lognb == 0:
+        return jnp.zeros_like(hi, jnp.int32)
+    return (hi >> jnp.uint32(32 - lognb)).astype(jnp.int32)
 
 
-def home_slot_host(lo: int, hi: int, cap: int) -> int:
-    """Host replica of _home_slot (must match bit-for-bit: initial states are
-    placed host-side and later device probes start from the same slot)."""
-    m = (1 << 32) - 1
-    h = ((lo ^ ((hi * 0x9E3779B1) & m)) * 0x85EBCA6B) & m
-    h ^= h >> 15
-    return h & (cap - 1)
+def bucket_of_host(hi: int, nbuckets: int) -> int:
+    lognb = nbuckets.bit_length() - 1
+    return (hi >> (32 - lognb)) if lognb else 0
 
 
 def host_insert(table: np.ndarray, lo: int, hi: int) -> bool:
     """Insert-or-find one fingerprint in a host-side [cap, 2] numpy table,
-    walking the exact probe sequence the device uses.  Returns is_new."""
+    walking the exact slot sequence the device uses (linear from the home
+    bucket's first slot).  Returns is_new."""
     cap = table.shape[0]
     if lo == 0 and hi == 0:
         lo = 1
-    home = home_slot_host(lo, hi, cap)
-    k = 0
-    while True:
-        slot = (home + (k * (k + 1) // 2)) & (cap - 1)
+    base = bucket_of_host(hi, cap // BUCKET) * BUCKET
+    for k in range(cap):
+        slot = (base + k) % cap
         r0, r1 = int(table[slot, 0]), int(table[slot, 1])
         if r0 == lo and r1 == hi:
             return False
@@ -98,33 +106,135 @@ def host_insert(table: np.ndarray, lo: int, hi: int) -> bool:
             table[slot, 0] = lo
             table[slot, 1] = hi
             return True
-        k += 1
+    raise RuntimeError("fingerprint table full")
 
 
-def fpset_insert(s: FPSet, lo, hi, mask) -> Tuple[FPSet, jnp.ndarray]:
-    """Insert-or-find a batch of fingerprints.
+def _probe_block(table, lo, hi, active, claim_width: int):
+    """Insert-or-find `active` entries of a fingerprint block that is
+    sorted ascending by (hi, lo) and duplicate-free.  Returns
+    (table, is_new).  table: [cap, 2]; lo/hi/active: [R]."""
+    cap = table.shape[0]
+    nb = cap // BUCKET
+    R = lo.shape[0]
+    C = min(claim_width, R)
+    bid = _bucket_of(hi, nb)
 
-    lo/hi: [N] uint32 lanes; mask: [N] bool (candidates to consider).
-    Returns (updated set, is_new [N] bool).  Duplicate fingerprints within
-    the batch yield exactly one is_new=True (the HIGHEST lane index - the
-    sort is stable, so attribution is deterministic and matches the v2
-    engine's scatter arbitration, keeping the committed outdegree
-    statistics - max 4 on Model_1, as TLC reports, MC.out:1104 - stable
-    across fpset generations).  The caller must keep occupancy + N below
-    capacity (the engine checks before calling).
+    tb = table.reshape(nb, BUCKET, 2)
+    bk = tb[bid]  # [R, B, 2] - one 64-byte access per candidate
+    hit = (bk[:, :, 0] == lo[:, None]) & (bk[:, :, 1] == hi[:, None])
+    found = active & hit.any(axis=1)
+    occ_mask = (bk[:, :, 0] != 0) | (bk[:, :, 1] != 0)
+    noccup = occ_mask.sum(axis=1).astype(jnp.int32)
+
+    # conflict-free slot assignment: same-bucket claimants are adjacent
+    # (bid is monotonic), so rank-in-run places them in distinct slots
+    want = active & ~found
+    start = jnp.concatenate([jnp.ones(1, bool), bid[1:] != bid[:-1]])
+    wc = jnp.cumsum(want.astype(jnp.int32))
+    base = lax.cummax(jnp.where(start, wc - want.astype(jnp.int32), 0))
+    rank = wc - want.astype(jnp.int32) - base
+    slot = noccup + rank
+    fits = want & (slot < BUCKET)
+
+    # compact claimers to a C-row scatter (row scatters cost ~140ns/row:
+    # scattering only the claimers is the win).  Claimers beyond C (or
+    # whose bucket is full) settle in the straggler loop.
+    claim_pos = jnp.cumsum(fits.astype(jnp.int32)) - 1
+    claimed = fits & (claim_pos < C)
+    tgt32 = (bid * BUCKET + slot).astype(jnp.uint32)
+    nf = (~claimed).astype(jnp.uint32)
+    _, t_tgt, t_lo, t_hi = lax.sort((nf, tgt32, lo, hi), num_keys=1,
+                                    is_stable=True)
+    nclaim = claimed.sum()
+    rows = jnp.stack([t_lo[:C], t_hi[:C]], axis=1)
+    wtgt = jnp.where(jnp.arange(C) < nclaim, t_tgt[:C].astype(jnp.int32), cap)
+    table = table.at[wtgt].set(rows, mode="drop")
+
+    is_new = claimed
+    pending = active & ~found & ~claimed
+
+    # straggler loop: compacted v3-style claim-verify, walking slots
+    # linearly from the home bucket start (keeps the lookup invariant:
+    # earliest empty slot in walk order is always taken)
+    S = min(R, 2048)
+    home_slot = (bid * BUCKET).astype(jnp.uint32)
+
+    def outer_cond(st):
+        table, is_new, pending = st
+        return pending.any()
+
+    def outer_body(st):
+        table, is_new, pending = st
+        npend = (~pending).astype(jnp.uint32)
+        pos = jnp.arange(R, dtype=jnp.uint32)
+        _, p_home, p_lo, p_hi, p_pos = lax.sort(
+            (npend, home_slot, lo, hi, pos), num_keys=1, is_stable=True
+        )
+        s_home = p_home[:S].astype(jnp.int32)
+        s_lo, s_hi = p_lo[:S], p_hi[:S]
+        s_pos = p_pos[:S].astype(jnp.int32)
+        s_act = jnp.arange(S) < jnp.minimum(pending.sum(), S)
+        s_rows = jnp.stack([s_lo, s_hi], axis=1)
+
+        def walk_cond(wst):
+            _, _, pend, _ = wst
+            return pend.any()
+
+        def walk_body(wst):
+            table, k, pend, new = wst
+            slot = (s_home + k) % cap
+            row = table[slot]
+            f = pend & (row[:, 0] == s_lo) & (row[:, 1] == s_hi)
+            e = pend & (row[:, 0] == 0) & (row[:, 1] == 0)
+            wt = jnp.where(e, slot, cap)
+            table = table.at[wt].set(s_rows, mode="drop")
+            row2 = table[slot]
+            won = e & (row2[:, 0] == s_lo) & (row2[:, 1] == s_hi)
+            new = new | won
+            pend = pend & ~(f | won)
+            k = jnp.where(pend, k + 1, k)
+            return table, k, pend, new
+
+        table, _, _, s_new = lax.while_loop(
+            walk_cond, walk_body,
+            (table, jnp.zeros(S, jnp.int32), s_act, jnp.zeros(S, bool)),
+        )
+        upd_pos = jnp.where(s_act, s_pos, R)
+        is_new = is_new.at[upd_pos].set(s_new, mode="drop")
+        pending = pending.at[upd_pos].set(False, mode="drop")
+        return table, is_new, pending
+
+    table, is_new, _ = lax.while_loop(
+        outer_cond, outer_body, (table, is_new, pending)
+    )
+    return table, is_new
+
+
+def fpset_insert_sorted(
+    s: FPSet, lo, hi, mask, probe_width: int = 0, claim_width: int = 0
+) -> Tuple[FPSet, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Insert-or-find a batch; results in *compacted* order.
+
+    lo/hi: [N] uint32; mask: [N] bool.  Returns (set, is_new_c [N] bool,
+    c_idx [N] int32, nreps int32): entry j < nreps of the compacted order
+    is the representative of a distinct masked fingerprint, originally at
+    lane c_idx[j]; is_new_c[j] says whether it was new to the table.
+    Representatives are fingerprint-sorted (ascending (hi, lo)).
+
+    In-batch duplicates resolve to the highest lane index (stable dedup
+    sort), keeping attribution deterministic across engines/backends.
+    probe_width bounds the per-segment probe row count (0 = whole batch);
+    claim_width bounds the round-0 claim scatter (0 = probe_width).
     """
-    cap = s.table.shape[0]
-    capm = cap - 1
     n = lo.shape[0]
+    R = min(probe_width or n, n)
+    C = min(claim_width or R, R)
     lo, hi = _remap(lo, hi)
 
-    # in-batch dedup: sort (invalid, hi, lo, lane) - validity is the
-    # leading key (NOT a sentinel fingerprint value, which a real
-    # fingerprint could equal), so invalid lanes segregate after all valid
-    # ones; the LAST of each run of equal keys is the representative, and
-    # only valid representatives probe.
+    # sort 1: group duplicates; validity is the leading key (NOT a
+    # sentinel fingerprint value, which a real fingerprint could equal)
     inval = (~mask).astype(jnp.uint32)
-    idx = jnp.arange(n, dtype=jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.uint32)
     s_inv, s_hi, s_lo, s_idx = lax.sort(
         (inval, hi, lo, idx), num_keys=3, is_stable=True
     )
@@ -136,35 +246,63 @@ def fpset_insert(s: FPSet, lo, hi, mask) -> Tuple[FPSet, jnp.ndarray]:
             jnp.ones(1, bool),
         ]
     )
-    rep_sorted = mask[s_idx] & last
-    rep = jnp.zeros(n, bool).at[s_idx].set(rep_sorted)
+    rep = (s_inv == 0) & last
 
-    home = _home_slot(lo, hi, cap)
-    rows = jnp.stack([lo, hi], axis=1)  # [n, 2]
+    # sort 2: compact representatives to the front (stable single-key sort
+    # keeps them fingerprint-sorted - required by _probe_block's rank math)
+    nonrep = (~rep).astype(jnp.uint32)
+    _, c_lo, c_hi, c_idx = lax.sort(
+        (nonrep, s_lo, s_hi, s_idx), num_keys=1, is_stable=True
+    )
+    nreps = rep.sum().astype(jnp.int32)
 
-    def cond(st):
-        _, _, pending, _ = st
-        return pending.any()
+    if R == n:
+        table, is_new_c = _probe_block(
+            s.table, c_lo, c_hi, jnp.arange(n) < nreps, C
+        )
+        return FPSet(table), is_new_c, c_idx.astype(jnp.int32), nreps
 
-    def body(st):
-        table, k, pending, is_new = st
-        slot = (home + ((k * (k + 1)) >> 1)) & capm
-        row = table[slot]  # [n, 2]
-        hit_lo, hit_hi = row[:, 0], row[:, 1]
-        found = pending & (hit_lo == lo) & (hit_hi == hi)
-        empty = pending & (hit_lo == 0) & (hit_hi == 0)
-        # claim: scatter whole rows into empty slots; one complete row wins
-        # per slot (batch fps are unique, so re-reading our own row back
-        # means we won)
-        wtgt = jnp.where(empty, slot, cap)
-        table = table.at[wtgt].set(rows, mode="drop")
-        row2 = table[slot]
-        won = empty & (row2[:, 0] == lo) & (row2[:, 1] == hi)
-        is_new = is_new | won
-        pending = pending & ~(found | won)
-        k = jnp.where(pending, k + 1, k)
-        return table, k, pending, is_new
+    # segment loop for batches wider than probe_width (rare: only when a
+    # chunk is nearly all-distinct); each segment stays fp-sorted.  Pad to
+    # a whole number of segments: dynamic_slice CLAMPS out-of-bounds start
+    # offsets, so an unpadded final partial segment would re-probe earlier
+    # entries and never probe the tail.
+    nseg = (n + R - 1) // R
+    pad = nseg * R - n
+    p_lo = jnp.pad(c_lo, (0, pad))
+    p_hi = jnp.pad(c_hi, (0, pad))
 
-    init = (s.table, jnp.zeros(n, jnp.int32), rep, jnp.zeros(n, bool))
-    table, _, _, is_new = lax.while_loop(cond, body, init)
-    return FPSet(table), is_new
+    def seg_cond(st):
+        table, is_new_p, seg = st
+        return (seg * R < nreps) & (seg < nseg)
+
+    def seg_body(st):
+        table, is_new_p, seg = st
+        off = seg * R
+        b_lo = lax.dynamic_slice(p_lo, (off,), (R,))
+        b_hi = lax.dynamic_slice(p_hi, (off,), (R,))
+        active = (jnp.arange(R) + off) < nreps
+        table, b_new = _probe_block(table, b_lo, b_hi, active, C)
+        is_new_p = lax.dynamic_update_slice(is_new_p, b_new, (off,))
+        return table, is_new_p, seg + 1
+
+    table, is_new_p, _ = lax.while_loop(
+        seg_cond, seg_body, (s.table, jnp.zeros(nseg * R, bool), jnp.int32(0))
+    )
+    return FPSet(table), is_new_p[:n], c_idx.astype(jnp.int32), nreps
+
+
+def fpset_insert(s: FPSet, lo, hi, mask) -> Tuple[FPSet, jnp.ndarray]:
+    """Insert-or-find a batch of fingerprints.
+
+    lo/hi: [N] uint32 lanes; mask: [N] bool (candidates to consider).
+    Returns (updated set, is_new [N] bool) in the original lane order.
+    Duplicate fingerprints within the batch yield exactly one is_new=True
+    (the highest lane index), keeping the committed outdegree statistics
+    (max 4 on Model_1, as TLC reports, MC.out:1104) stable across fpset
+    generations.  The caller must keep occupancy + N below capacity (the
+    engine checks before calling)."""
+    n = lo.shape[0]
+    s2, is_new_c, c_idx, _ = fpset_insert_sorted(s, lo, hi, mask)
+    is_new = jnp.zeros(n, bool).at[c_idx].set(is_new_c)
+    return s2, is_new

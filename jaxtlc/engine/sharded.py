@@ -480,7 +480,8 @@ def check_sharded_with_checkpoints(
         if ckpt_path is None or not os.path.exists(ckpt_path):
             raise FileNotFoundError(f"no checkpoint at {ckpt_path!r}")
         saved_meta, carry = load_checkpoint(ckpt_path, template)
-        for key in ("config", "queue_capacity", "fp_capacity", "devices"):
+        for key in ("format", "config", "queue_capacity", "fp_capacity",
+                    "devices"):
             if saved_meta.get(key) != meta[key]:
                 raise ValueError(
                     f"checkpoint {key} mismatch: "

@@ -46,7 +46,10 @@ struct Store {
 };
 
 inline uint64_t home_slot(uint64_t fp, uint64_t cap) {
-  // match ../engine/fpset.py _home_slot on the (lo, hi) halves
+  // The host tier keeps its own avalanche hash + triangular probing,
+  // deliberately independent of the device table's bucketized layout
+  // (../engine/fpset.py): the two stores never exchange slot indices,
+  // only membership verdicts.
   uint32_t lo = static_cast<uint32_t>(fp);
   uint32_t hi = static_cast<uint32_t>(fp >> 32);
   uint32_t h = (lo ^ (hi * 0x9E3779B1u)) * 0x85EBCA6Bu;

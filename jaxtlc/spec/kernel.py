@@ -51,10 +51,6 @@ def _sel(mask, a, b):
     return jax.tree.map(lambda x, y: jnp.where(mask, x, y), a, b)
 
 
-class Lane(Tuple):
-    pass
-
-
 def lane_layout(cfg: ModelConfig) -> Tuple[int, int]:
     """(CL, L): client lane-block width and total lane count.  Lane l acts
     for process l // CL when l < nc*CL, else the server.  Single source of
@@ -473,7 +469,7 @@ def make_kernel(cfg: ModelConfig):
         afail = valid & ~(is_create | is_force | is_get | is_delete | is_update)
 
         new_api = jnp.where(
-            is_create[..., None] if False else is_create,
+            is_create,
             create_api,
             jnp.where(
                 is_force,

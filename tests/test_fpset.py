@@ -61,6 +61,29 @@ def test_all_ones_fingerprint_with_masked_lanes():
     assert int(fpset_count(s)) == 1
 
 
+def test_segmented_probe_partial_final_segment():
+    # regression: probe_width not dividing the batch must not clamp the
+    # final partial segment (dynamic_slice clamps OOB starts; the unpadded
+    # version re-probed earlier entries and never probed the tail)
+    from jaxtlc.engine.fpset import fpset_insert_sorted
+
+    s = fpset_new(1 << 8)
+    vals = np.arange(10, dtype=np.uint32)
+    s, is_new_c, c_idx, nreps = fpset_insert_sorted(
+        s, jnp.asarray(vals), jnp.asarray(vals ^ 0xABCD), jnp.ones(10, bool),
+        probe_width=4,
+    )
+    assert int(nreps) == 10
+    assert int(np.asarray(is_new_c).sum()) == 10
+    assert int(fpset_count(s)) == 10
+    # idempotence: nothing is new the second time
+    s, is_new_c, _, _ = fpset_insert_sorted(
+        s, jnp.asarray(vals), jnp.asarray(vals ^ 0xABCD), jnp.ones(10, bool),
+        probe_width=4,
+    )
+    assert not np.asarray(is_new_c).any()
+
+
 def test_high_load():
     s = fpset_new(1 << 10)
     vals = np.arange(700, dtype=np.uint32)
