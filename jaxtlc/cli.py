@@ -192,8 +192,20 @@ def _run_check(args) -> int:
             log.msg(1000, f"Run stopped: {r.violation_name}", severity=1)
         _print_trace(log, spec.model, args.chunk)
     elif not liveness_violated:
-        log.success(r.distinct)
-        log.coverage(2, r.action_generated, r.action_distinct)
+        log.success(r.generated, r.distinct,
+                    getattr(r, "actual_fp_collision", None))
+        if args.coverage:
+            # full per-expression dump (MC.out:44-1092): re-walk the space
+            # with the instrumented evaluator (host-side; slow for large
+            # configs - TLC's coverage mode pays a similar tax)
+            from .spec.coverage import render_coverage, run_coverage
+
+            cov = run_coverage(spec.model)
+            stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+            for line in render_coverage(cov, stamp, tool_mode=log.tool):
+                log.raw(line)
+        else:
+            log.coverage(2, r.action_generated, r.action_distinct)
 
     log.progress(r.depth, r.generated, r.distinct, r.queue_left)
     log.final_counts(r.generated, r.distinct, r.queue_left)
@@ -247,6 +259,9 @@ def main(argv=None) -> int:
                    help="chunks between checkpoints")
     c.add_argument("-recover", action="store_true",
                    help="resume from -checkpoint PATH (TLC -recover analog)")
+    c.add_argument("-coverage", action="store_true",
+                   help="emit the full per-expression coverage dump "
+                        "(TLC coverage mode; re-walks the space host-side)")
     c.add_argument("-liveness", action="store_true",
                    help="check the declared temporal properties even when "
                         "the launch config disables them (E8)")

@@ -2,30 +2,44 @@
 
 The TLC BFS core replacement (tlc2.tool.Worker + DiskStateQueue +
 OffHeapDiskFPSet, /root/reference/KubeAPI.toolbox/Model_1/MC.out:5): one
-``lax.while_loop`` whose body pops a fixed-size chunk from a device-resident
-ring-buffer frontier, expands it through the vmapped next-state kernel,
-evaluates invariants, fingerprints + dedups against the device hash table,
-and appends the new states - no host round-trips until the state space is
-exhausted or a violation is found.
+``lax.while_loop`` whose body pops a fixed-size chunk from the frontier,
+expands it through the vmapped next-state kernel, evaluates invariants,
+fingerprints + dedups against the device hash table, and appends the new
+states - no host round-trips until the state space is exhausted or a
+violation is found.
+
+v4 data layout, driven by on-chip microbenchmarks (tools/microbench.py:
+random row scatters ~140ns/row dominate; contiguous dynamic-slice writes
+are 3-9x cheaper; sorts are cheap):
+
+* The frontier is a ping-pong pair of level buffers of *packed* state
+  words ([2, qcap + 2*chunk, W] uint32): pops are contiguous dynamic
+  slices, appends are contiguous dynamic-update-slices of fingerprint-
+  sorted new states - no row scatters on the queue at all.  States are
+  unpacked to field vectors only at the kernel boundary (codec.unpack).
+* Dedup probes only the sort-compacted unique candidates
+  (fpset.fpset_insert_sorted), and per-new-state bookkeeping (enqueue,
+  per-action distinct counts, outdegree credit) runs over compacted
+  A-wide segments instead of the full chunk*L candidate array.
+* Fingerprints ride the MXU (fingerprint.fp64_words_mxu).
+* Per-action generated counters are factorized through the dispatch
+  structure (all lanes of a client share that client's pc label; server
+  lanes are always APIStart) instead of scatter-adds over all candidates.
 
 Level-synchronous by construction: a chunk never crosses a BFS level
-boundary (`level_end` fences the FIFO), so reported depth is the exact BFS
-level count, matching TLC's "depth of the complete state graph search"
-(MC.out:1101), and in-batch fingerprint arbitration never has to choose
-between states of different levels.
+boundary, so reported depth is the exact BFS level count, matching TLC's
+"depth of the complete state graph search" (MC.out:1101), and in-batch
+fingerprint arbitration never has to choose between states of different
+levels.
 
-Violation handling: the fused loop carries a violation code + the offending
-encoded state; on violation the CLI re-runs in the host driver
+Violation handling: the fused loop carries a violation code + the
+offending encoded state; on violation the CLI re-runs in the host driver
 (engine.hostdriver) which keeps parent pointers and reconstructs the
 counterexample trace (TLC trace-explorer analog, SURVEY.md §2.3 E11).
-
-Counters are maintained per action label (generated + distinct), feeding the
-TLC-style coverage report (E9) in io/tlc_log.py.
 """
 
 from __future__ import annotations
 
-import functools
 import time
 from typing import NamedTuple
 
@@ -37,10 +51,10 @@ from jax import lax
 from ..config import ModelConfig
 from ..spec.codec import get_codec
 from ..spec.invariants import make_invariant_kernel
-from ..spec.kernel import initial_vectors, make_kernel
-from ..spec.labels import LABELS
-from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED, fp64_words
-from .fpset import FPSet, fpset_insert, fpset_new
+from ..spec.kernel import initial_vectors, lane_layout, make_kernel
+from ..spec.labels import LABEL_ID, LABELS
+from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED, fp64_words_mxu
+from .fpset import fpset_insert_sorted, fpset_new
 
 # violation codes
 OK = 0
@@ -67,11 +81,12 @@ VIOLATION_NAMES = {
 
 
 class EngineCarry(NamedTuple):
-    fps: FPSet
-    queue: jnp.ndarray  # [qcap + 1, F] (last row = scatter dump)
-    qhead: jnp.ndarray  # int32
-    qtail: jnp.ndarray  # int32
-    level_end: jnp.ndarray  # int32: queue index fencing the current level
+    fps: "FPSet"  # noqa: F821 - fpset.FPSet
+    queue: jnp.ndarray  # [2, qcap + 2*chunk, W] uint32 packed level buffers
+    parity: jnp.ndarray  # int32: which buffer holds the CURRENT level
+    qhead: jnp.ndarray  # int32: pop position within the current level
+    level_n: jnp.ndarray  # int32: states in the current level
+    next_n: jnp.ndarray  # int32: states appended to the next level so far
     level: jnp.ndarray  # int32: BFS level of states being popped (init = 1)
     depth: jnp.ndarray  # int32: deepest nonempty level
     generated: jnp.ndarray  # uint32
@@ -101,6 +116,16 @@ class CheckResult(NamedTuple):
     # (avg, min, max, p95) of TLC's outdegree = distinct new states per
     # expanded state (matches MC.out:1104); None when not tracked (sharded)
     outdegree: tuple = None
+    # TLC's "based on the actual fingerprints" collision estimate
+    # (MC.out:42); None when the engine variant doesn't compute it
+    actual_fp_collision: float = None
+
+
+def carry_done(carry: EngineCarry) -> bool:
+    """Host-side termination check (used by the checkpointed driver)."""
+    return (
+        int(carry.level_n) - int(carry.qhead) <= 0 and int(carry.next_n) == 0
+    ) or int(carry.viol) != OK
 
 
 def make_engine(
@@ -117,32 +142,53 @@ def make_engine(
     run_fn(carry) -> EngineCarry after exhaustion/violation (jitted, fused).
     step_fn(carry) -> EngineCarry after ONE chunk (jitted; for checkpointed
     / incremental runs).
+
+    queue_capacity bounds the width of a single BFS level (the frontier),
+    not the total state count: levels ping-pong between two buffers.
     """
     cdc = get_codec(cfg)
     F = cdc.n_fields
+    W = (cdc.nbits + 31) // 32
     step = make_kernel(cfg)
     L = step.n_lanes
+    CL, _ = lane_layout(cfg)
+    nc = cdc.nc
     inv_check = make_invariant_kernel(cfg)
     n_labels = len(LABELS)
     nbits = cdc.nbits
     qcap = queue_capacity
+    # two-tier adaptive stepping: a step's cost is dominated by fixed
+    # chunk-sized work regardless of how few states it pops, so narrow
+    # levels (the BFS ramp/tail) and level remainders run a small body
+    # instead of paying a full big-chunk step
+    small = chunk // 16 if chunk >= 1 << 14 else 0
+
+    pc_off = cdc.offsets["pc"]
+    label_ids = jnp.arange(n_labels, dtype=jnp.int32)
+    APISTART_ID = LABEL_ID["APIStart"]
 
     def init_fn() -> EngineCarry:
         inits = jnp.asarray(initial_vectors(cfg))
         n0 = inits.shape[0]
-        queue = jnp.zeros((qcap + 1, F), jnp.int32).at[:n0].set(inits)
-        packed = cdc.pack(inits)
-        lo, hi = fp64_words(packed, nbits, fp_index, seed)
-        fps, is_new = fpset_insert(
+        assert n0 <= chunk and n0 <= qcap, "raise chunk/queue_capacity"
+        packed0 = cdc.pack(inits)
+        queue = (
+            jnp.zeros((2, qcap + 2 * chunk, W), jnp.uint32)
+            .at[0, :n0]
+            .set(packed0)
+        )
+        lo, hi = fp64_words_mxu(packed0, nbits, fp_index, seed)
+        fps, is_new_c, _, _ = fpset_insert_sorted(
             fpset_new(fp_capacity), lo, hi, jnp.ones(n0, bool)
         )
-        distinct0 = is_new.sum().astype(jnp.uint32)
+        distinct0 = is_new_c.sum().astype(jnp.uint32)
         return EngineCarry(
             fps=fps,
             queue=queue,
+            parity=jnp.int32(0),
             qhead=jnp.int32(0),
-            qtail=jnp.int32(n0),
-            level_end=jnp.int32(n0),
+            level_n=jnp.int32(n0),
+            next_n=jnp.int32(0),
             level=jnp.int32(1),
             depth=jnp.int32(1),
             generated=jnp.uint32(n0),
@@ -155,13 +201,29 @@ def make_engine(
             viol_action=jnp.int32(-1),
         )
 
-    def body(c: EngineCarry) -> EngineCarry:
-        avail = jnp.minimum(c.level_end, c.qtail) - c.qhead
+    def make_body(ck: int):
+        """One BFS step popping up to `ck` states (carry shape-invariant)."""
+        ncand = ck * L
+        # compaction widths: probe/claim/enqueue touch only this many rows
+        # per segment; steady-state new-per-chunk == chunk, so 2x covers
+        # bursts and the segment loops keep worst cases exact
+        R = min(2 * ck, ncand)  # fpset probe width
+        CW = min(2 * ck, R)  # fpset round-0 claim width
+        A = min(2 * ck, ncand)  # enqueue/stat segment width
+        return lambda c: step_body(c, ck, ncand, R, CW, A)
+
+    def step_body(c: EngineCarry, chunk: int, ncand: int, R: int, CW: int,
+                  A: int) -> EngineCarry:
+        avail = c.level_n - c.qhead
         n = jnp.minimum(chunk, avail)
         rows = jnp.arange(chunk, dtype=jnp.int32)
         mask = rows < n
-        idx = (c.qhead + rows) % qcap
-        batch = c.queue[idx]
+
+        # contiguous pop (the buffer is chunk-padded so no OOB clamping)
+        block = lax.dynamic_slice(
+            c.queue, (c.parity, c.qhead, jnp.int32(0)), (1, chunk, W)
+        )[0]
+        batch = cdc.unpack(block)
 
         succs, valid, action, afail, ovf = jax.vmap(step)(batch)
         valid = valid & mask[:, None]
@@ -169,7 +231,7 @@ def make_engine(
         ovf = ovf & valid
         dead = mask & ~valid.any(axis=1)
 
-        flat = succs.reshape(chunk * L, F)
+        flat = succs.reshape(ncand, F)
         fvalid = valid.reshape(-1)
         faction = action.reshape(-1)
 
@@ -178,30 +240,89 @@ def make_engine(
         bad_oov = fvalid & ((inv & 2) == 0)
 
         packed = cdc.pack(flat)
-        lo, hi = fp64_words(packed, nbits, fp_index, seed)
+        lo, hi = fp64_words_mxu(packed, nbits, fp_index, seed)
 
-        fp_full = (c.distinct.astype(jnp.int32) + chunk * L) > int(
+        fp_full = (c.distinct.astype(jnp.int32) + ncand) > int(
             fp_capacity * 0.85
         )
         insert_mask = fvalid & ~fp_full
-        fps, is_new = fpset_insert(c.fps, lo, hi, insert_mask)
+        fps, is_new_c, c_idx, _ = fpset_insert_sorted(
+            c.fps, lo, hi, insert_mask, probe_width=R, claim_width=CW
+        )
+        n_new = is_new_c.sum().astype(jnp.int32)
+        q_full = c.next_n + n_new > qcap
 
-        n_new = is_new.sum().astype(jnp.int32)
-        q_full = (c.qtail - c.qhead) + n_new > qcap
+        # enqueue + per-new-state stats over compacted A-wide segments:
+        # bring new entries to the front ordered by original lane index
+        # (2-key sort) - the same append order as the v3 scatter engine, so
+        # pop order and therefore in-batch attribution statistics (outdegree
+        # min/max, MC.out:1104) are preserved bit-for-bit
+        _, e_idx = lax.sort(
+            ((~is_new_c).astype(jnp.uint32), c_idx.astype(jnp.uint32)),
+            num_keys=2,
+            is_stable=True,
+        )
+        e_idx_p = jnp.concatenate([e_idx, jnp.zeros(A, jnp.uint32)])
 
-        # append new states (prefix-sum positions; dump row for non-new)
-        pos = c.qtail + jnp.cumsum(is_new.astype(jnp.int32)) - 1
-        tgt = jnp.where(is_new & ~q_full, pos % qcap, qcap)
-        queue = c.queue.at[tgt].set(flat)
+        def enq_cond(st):
+            _, _, _, s = st
+            return s * A < n_new
 
-        # counters
+        def enq_body(st):
+            queue, act_dist, deg, s = st
+            offs = s * A
+            idx_a = lax.dynamic_slice(e_idx_p, (offs,), (A,)).astype(
+                jnp.int32
+            )
+            active = (jnp.arange(A) + offs) < n_new
+            rows_a = packed[idx_a]  # [A, W] row gather (the only one)
+            acts_a = faction[idx_a]
+            woff = jnp.minimum(c.next_n + offs, qcap)
+            queue = lax.dynamic_update_slice(
+                queue, rows_a[None], (1 - c.parity, woff, jnp.int32(0))
+            )
+            act_dist = act_dist.at[
+                jnp.where(active, acts_a, n_labels)
+            ].add(1)
+            deg = deg.at[jnp.where(active, idx_a // L, chunk)].add(1)
+            return queue, act_dist, deg, s + 1
+
+        queue, act_dist, deg, _ = lax.while_loop(
+            enq_cond,
+            enq_body,
+            (
+                c.queue,
+                c.act_dist,
+                jnp.zeros(chunk + 1, jnp.uint32),
+                jnp.int32(0),
+            ),
+        )
+
+        # outdegree histogram of the popped states (TLC's outdegree =
+        # distinct new successors per expansion, MC.out:1104)
+        degv = jnp.where(mask, deg[:chunk].astype(jnp.int32), L + 1)
+        outdeg_hist = c.outdeg_hist + (
+            degv[:, None] == jnp.arange(L + 2)[None, :]
+        ).sum(axis=0).astype(jnp.uint32)
+
+        # per-action generated counters, factorized through the dispatch
+        # structure: every lane of client ci fires that client's current pc
+        # label; server lanes are always APIStart
+        act_gen = c.act_gen
+        gen_counts = jnp.zeros(n_labels, jnp.uint32)
+        for ci in range(nc):
+            vc = valid[:, ci * CL : (ci + 1) * CL].sum(axis=1)
+            pcs = batch[:, pc_off + ci]
+            gen_counts = gen_counts + (
+                (pcs[:, None] == label_ids[None, :]) * vc[:, None]
+            ).sum(axis=0).astype(jnp.uint32)
+        gen_counts = gen_counts.at[APISTART_ID].add(
+            valid[:, nc * CL :].sum().astype(jnp.uint32)
+        )
+        act_gen = act_gen.at[:n_labels].add(gen_counts)
+
         generated = c.generated + valid.sum().astype(jnp.uint32)
         distinct = c.distinct + n_new.astype(jnp.uint32)
-        act_gen = c.act_gen.at[jnp.where(fvalid, faction, n_labels)].add(1)
-        act_dist = c.act_dist.at[jnp.where(is_new, faction, n_labels)].add(1)
-        # TLC outdegree = distinct new successors per expanded state
-        newdeg = is_new.reshape(chunk, L).sum(axis=1)
-        outdeg_hist = c.outdeg_hist.at[jnp.where(mask, newdeg, L + 1)].add(1)
 
         # violations (first wins; priority: invariant > assert > deadlock >
         # capacity).  Capture the offending state: candidate for invariants,
@@ -232,21 +353,25 @@ def make_engine(
         hit = q_full & (viol == OK)
         viol = jnp.where(hit, VIOL_QUEUE_FULL, viol)
 
-        # advance FIFO + level bookkeeping
+        # level bookkeeping: ping-pong at the level boundary
         qhead = c.qhead + n
-        qtail = jnp.where(q_full, c.qtail, c.qtail + n_new)
-        level_done = qhead == c.level_end
-        more = qtail > qhead
-        level = jnp.where(level_done & more, c.level + 1, c.level)
-        depth = jnp.maximum(c.depth, jnp.where(more, level, c.level))
-        level_end = jnp.where(level_done, qtail, c.level_end)
+        next_n = jnp.minimum(c.next_n + n_new, qcap)
+        level_done = qhead >= c.level_n
+        advance = level_done & (next_n > 0)
+        parity = jnp.where(level_done, 1 - c.parity, c.parity)
+        level_n = jnp.where(level_done, next_n, c.level_n)
+        next_n = jnp.where(level_done, 0, next_n)
+        qhead = jnp.where(level_done, 0, qhead)
+        level = jnp.where(advance, c.level + 1, c.level)
+        depth = jnp.maximum(c.depth, level)
 
         return EngineCarry(
             fps=fps,
             queue=queue,
+            parity=parity,
             qhead=qhead,
-            qtail=qtail,
-            level_end=level_end,
+            level_n=level_n,
+            next_n=next_n,
             level=level,
             depth=depth,
             generated=generated,
@@ -259,8 +384,19 @@ def make_engine(
             viol_action=viol_action,
         )
 
+    big_body = make_body(chunk)
+    if small:
+        small_body = make_body(small)
+        # break-even: a big step costs ~what chunk/small small steps cost,
+        # so take the big body only when the level remainder mostly fills it
+        def body(c: EngineCarry) -> EngineCarry:
+            avail = c.level_n - c.qhead
+            return lax.cond(avail >= chunk // 2, big_body, small_body, c)
+    else:
+        body = big_body
+
     def cond(c: EngineCarry):
-        return (c.qtail > c.qhead) & (c.viol == OK)
+        return ((c.qhead < c.level_n) | (c.next_n > 0)) & (c.viol == OK)
 
     @jax.jit
     def run_fn(c: EngineCarry) -> EngineCarry:
@@ -295,7 +431,10 @@ def check(
     t0 = time.time()
     carry = jax.block_until_ready(compiled(carry))
     wall = time.time() - t0
-    return result_from_carry(carry, wall)
+    from .fpset import fpset_actual_collision
+
+    afc = float(fpset_actual_collision(carry.fps))
+    return result_from_carry(carry, wall)._replace(actual_fp_collision=afc)
 
 
 def outdegree_from_hist(hist: np.ndarray):
@@ -330,7 +469,7 @@ def result_from_carry(
         generated=int(carry.generated),
         distinct=int(carry.distinct),
         depth=int(carry.depth),
-        queue_left=int(carry.qtail - carry.qhead),
+        queue_left=int(carry.level_n) - int(carry.qhead) + int(carry.next_n),
         violation=int(carry.viol),
         violation_name=VIOLATION_NAMES[int(carry.viol)],
         violation_state=np.asarray(carry.viol_state),

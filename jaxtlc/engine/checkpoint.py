@@ -29,7 +29,13 @@ import numpy as np
 from jax import lax
 
 from ..config import ModelConfig
-from .bfs import OK, CheckResult, EngineCarry, make_engine, result_from_carry
+from .bfs import (
+    CheckResult,
+    EngineCarry,
+    carry_done,
+    make_engine,
+    result_from_carry,
+)
 from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
 
 # v2: fingerprint-table layout changed from triangular avalanche-hash
@@ -131,11 +137,12 @@ def check_with_checkpoints(
         if ckpt_path is None or not os.path.exists(ckpt_path):
             raise FileNotFoundError(f"no checkpoint at {ckpt_path!r}")
         saved_meta, carry = load_checkpoint(ckpt_path, template)
-        # chunk (and checkpoint cadence) may legitimately change across a
-        # resume; the config and every parameter that shapes the carry or
-        # the fingerprint function must not.
-        for key in ("format", "config", "queue_capacity", "fp_capacity",
-                    "fp_index", "seed"):
+        # every parameter that shapes the carry or the fingerprint function
+        # must match - including chunk, which sizes the queue padding and
+        # the adaptive-step bodies (only the checkpoint CADENCE may change
+        # across a resume)
+        for key in ("format", "config", "chunk", "queue_capacity",
+                    "fp_capacity", "fp_index", "seed"):
             if saved_meta.get(key) != meta[key]:
                 raise ValueError(
                     f"checkpoint {key} mismatch: "
@@ -146,10 +153,7 @@ def check_with_checkpoints(
 
     segments = 0
     while True:
-        done = (int(carry.qtail) <= int(carry.qhead)) or (
-            int(carry.viol) != OK
-        )
-        if done:
+        if carry_done(carry):
             break
         if max_segments is not None and segments >= max_segments:
             break
@@ -159,4 +163,9 @@ def check_with_checkpoints(
             save_checkpoint(ckpt_path, carry, meta)
 
     wall = time.time() - t0
-    return result_from_carry(carry, wall, iterations=segments)
+    from .fpset import fpset_actual_collision
+
+    afc = float(fpset_actual_collision(carry.fps))
+    return result_from_carry(carry, wall, iterations=segments)._replace(
+        actual_fp_collision=afc
+    )

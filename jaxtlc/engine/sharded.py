@@ -61,7 +61,7 @@ from .fpset import FPSet, fpset_insert, host_insert
 class ShardCarry(NamedTuple):
     """Per-device state; every leaf's leading axis is the mesh axis."""
 
-    table: jnp.ndarray  # [D, cap, 2] uint32 fingerprint rows ((0,0)=empty)
+    table: jnp.ndarray  # [D, cap/8, 16] uint32 interleaved bucket rows
     queue: jnp.ndarray  # [D, qcap + 1, F]
     qhead: jnp.ndarray  # [D]
     qtail: jnp.ndarray  # [D]
@@ -135,7 +135,9 @@ def make_sharded_engine(
         own = np.asarray(owner_of(hi))
         queue = np.zeros((D, qcap + 1, F), np.int32)
         qtail = np.zeros(D, np.int32)
-        table = np.zeros((D, fp_capacity, 2), np.uint32)
+        # interleaved bucket rows (fpset.FPSet layout); host_insert views
+        # the same memory as flat [cap, 2] slot rows
+        table = np.zeros((D, fp_capacity // 8, 16), np.uint32)
         lo_np, hi_np = np.asarray(lo), np.asarray(hi)
         distinct = np.zeros(D, np.uint32)
         for i in range(inits.shape[0]):

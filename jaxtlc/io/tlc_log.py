@@ -16,6 +16,7 @@ MC.out:44-1092's per-action `distinct:generated` lines.
 
 from __future__ import annotations
 
+import re
 import sys
 import time
 from typing import Dict, Optional, TextIO
@@ -57,6 +58,12 @@ class TLCLog:
         # stream before test harnesses / redirections can swap it)
         self.out = sys.stdout if out is None else out
         self.tool = tool_mode
+
+    def raw(self, line: str) -> None:
+        """Emit a pre-framed line verbatim (the coverage renderer frames
+        its own messages)."""
+        self.out.write(line + "\n")
+        self.out.flush()
 
     def msg(self, code: int, text: str, severity: int = 0) -> None:
         if self.tool:
@@ -102,16 +109,29 @@ class TLCLog:
             f"found, {queue:,} states left on queue.",
         )
 
-    def success(self, distinct: int) -> None:
-        p = collision_probability(distinct)
-        self.msg(
-            2193,
+    @staticmethod
+    def _efmt(v: float) -> str:
+        """Java-style %.1E: no leading zero in the exponent (3.7E-9)."""
+        return re.sub(r"E([+-])0+(\d)", r"E\1\2", f"{v:.1E}")
+
+    def success(self, generated: int, distinct: int,
+                actual: float = None) -> None:
+        """The full 2193 success text (MC.out:38-42): both collision
+        estimates when the engine computed the actual-fingerprint one."""
+        p = collision_probability(generated, distinct)
+        body = (
             "Model checking completed. No error has been found.\n"
             "  Estimates of the probability that TLC did not check all "
             "reachable states\n"
             "  because two distinct states had the same fingerprint:\n"
-            f"  calculated (optimistic):  val = {p:.1E}",
+            f"  calculated (optimistic):  val = {self._efmt(p)}"
         )
+        if actual is not None:
+            body += (
+                f"\n  based on the actual fingerprints:  "
+                f"val = {self._efmt(actual)}"
+            )
+        self.msg(2193, body)
 
     def coverage(self, init_count: int, act_gen: Dict[str, int],
                  act_dist: Dict[str, int]) -> None:
@@ -127,8 +147,7 @@ class TLCLog:
                 continue
             g = act_gen.get(name, 0)
             d = act_dist.get(name, 0)
-            if g == 0 and d == 0:
-                continue
+            # zero-fire actions print 0:0, exactly as TLC does
             # span matches the reference label token (col len+6, cf. the
             # committed MC.out action lines); code 2772 = action coverage
             self.msg(

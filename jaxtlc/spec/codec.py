@@ -364,6 +364,37 @@ class Codec:
             words.append(cur)
         return jnp.stack(words, axis=-1)
 
+    def unpack(self, words):
+        """[..., W] uint32 packed words -> [..., F] int32 field vectors.
+
+        Exact inverse of pack() (property-tested in tests/test_codec.py);
+        the packed form is the engine's at-rest representation (queue rows,
+        fingerprint input), unpacked only at the kernel boundary.
+        """
+        w = words.astype(jnp.uint32)
+        out = [None] * self.n_fields
+        wi = 0
+        bitpos = 0
+        for f in self.fields:
+            off = self.offsets[f.name]
+            for j in range(f.count):
+                width = f.width
+                val = jnp.zeros_like(w[..., 0])
+                got = 0
+                while got < width:
+                    take = min(width - got, 32 - bitpos)
+                    piece = (w[..., wi] >> bitpos) & jnp.uint32(
+                        (1 << take) - 1
+                    )
+                    val = val | (piece << got)
+                    got += take
+                    bitpos += take
+                    if bitpos == 32:
+                        wi += 1
+                        bitpos = 0
+                out[off + j] = val.astype(jnp.int32)
+        return jnp.stack(out, axis=-1)
+
     # -- kernel-facing structured view --------------------------------------
 
     def to_sdict(self, vec):

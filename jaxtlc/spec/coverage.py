@@ -110,13 +110,26 @@ def _unbound(cov: Cov, k: str, pvc) -> bool:
     return not has(fld(pvc, "spec"), "pvname")
 
 
+def _enum_key(o):
+    """apiState enumeration order for SHORT-CIRCUITING quantifiers.
+
+    TLC visits set elements in its internal value order; the committed
+    log's short-circuit visit counts (e.g. the Get arm's \\E body logging
+    exactly 2 visits per service, MC.out:717-block) pin that order as
+    Secret/"foo" before PVC/"mypvc" - reproduced here by ordering on
+    (name, kind).  Only fitted to the committed run: full traversals are
+    order-insensitive, so this only affects which element short-circuits
+    a quantifier."""
+    return (fld(o, "n"), fld(o, "k"), _ckey(o))
+
+
 def _object_exists(cov: Cov, k: str, api, target) -> bool:
     """ObjectExists (:410): k.w whole body per call, k.body per binding
     (short-circuit at the first match), k.dom the apiState reference,
     k.arg the argument record."""
     cov.hit(k + ".w")
     cov.hit(k + ".dom")
-    for o in sorted(api, key=_ckey):
+    for o in sorted(api, key=_enum_key):
         cov.hit(k + ".body")
         cov.hit(k + ".arg")
         if fld(o, "n") == fld(target, "n") and fld(o, "k") == fld(target, "k"):
@@ -130,7 +143,7 @@ def _exists_ivo(cov: Cov, k: str, api, target) -> bool:
     call expr k.call, the 390 tree under k.ivo, and the argument spans
     k.argo / k.argr; short-circuits at the first match."""
     cov.hit(k + ".dom")
-    for o in sorted(api, key=_ckey):
+    for o in sorted(api, key=_enum_key):
         cov.hit(k + ".call")
         cov.hit(k + ".argo")
         cov.hit(k + ".argr")
@@ -347,6 +360,7 @@ def _client(cov, st, cfg, i, self, out) -> None:
         bad = fld(req, "status") != "Ok"
         if not bad:
             cov.hit("C13.o2")
+            cov.hit("C13.ubarg")  # the argument expr (:590 col 65-82)
             bad = _unbound(cov, "C13.ub", fld(req, "obj"))
         cov.hit("C13.then" if bad else "C13.else")
         cov.hit("C13.un")
@@ -634,7 +648,7 @@ def _server(cov, st, cfg, out) -> None:
                             cov.hit("AS.u.if")
                             cov.hit("AS.u.dom")
                             found = False
-                            for o in sorted(api, key=_ckey):
+                            for o in sorted(api, key=_enum_key):
                                 cov.hit("AS.u.body")
                                 cov.hit("AS.u.bivoc")
                                 cov.hit("AS.u.bo")
@@ -860,3 +874,53 @@ def run_coverage(cfg: ModelConfig) -> CoverageResult:
         cov, generated, len(seen), depth, dict(act_gen), dict(act_dist),
         len(inits),
     )
+
+
+# ---------------------------------------------------------------------------
+# TLC-format rendering of the per-expression dump (MC.out:44-1092)
+# ---------------------------------------------------------------------------
+
+
+def render_coverage(result: CoverageResult, timestamp: str,
+                    tool_mode: bool = True) -> List[str]:
+    """Render the dump in TLC's message framing.
+
+    One @!@!@-framed message per line (plain lines with tool_mode=False,
+    matching the CLI's -noTool), exactly as TLC's coverage section:
+    2201 banner, 2772/2773/2774 action/init/invariant headers,
+    2221/2775 span-visit lines (2775 = set-valued cost lines, printed as
+    visits:cost).  The span order and message codes come from the
+    generated coverage_spans table.  Action headers print this engine's
+    `distinct` attribution (TLC's own per-action distinct split is a
+    worker-interleaving artifact; `generated` is attribution-free and
+    matches exactly - see tests/test_coverage.py).
+    """
+    from .coverage_spans import MODULE, SPANS
+
+    lines: List[str] = []
+
+    def msg(code: int, body: str) -> None:
+        if tool_mode:
+            lines.append(f"@!@!@STARTMSG {code}:0 @!@!@")
+        lines.append(body)
+        if tool_mode:
+            lines.append(f"@!@!@ENDMSG {code} @!@!@")
+
+    msg(2201, f"The coverage statistics at {timestamp}")
+    for name, code, loc, spans in SPANS:
+        if code == 2773:  # Init
+            msg(code, f"<{name} {loc} of module {MODULE}>: "
+                      f"{result.n_inits}:{result.n_inits}")
+        elif code == 2774:  # invariant header (no counts)
+            msg(code, f"<{name} {loc} of module {MODULE}>")
+        else:  # 2772: action header distinct:generated
+            d = result.act_dist.get(name, 0)
+            g = result.act_gen.get(name, 0)
+            msg(code, f"<{name} {loc} of module {MODULE}>: {d}:{g}")
+        for dep, lloc, key, lcode, has_cost, _cexact in spans:
+            n = result.cov.n.get(key, 0)
+            body = f"  {'|' * dep}{lloc} of module {MODULE}: {n}"
+            if has_cost:
+                body += f":{result.cov.cost.get(key, 0)}"
+            msg(lcode, body)
+    return lines

@@ -14,10 +14,11 @@ unrolled into lanes:
 * lanes [2*CL, 2*CL+NC)  - APIServer servicing client c's pending request
 * lanes [.., 2*CL+2*NC)  - APIServer servicing client c's pending list
 
-where CL = max(3, LS): 3 covers DoRequest's per-disjunct failure lanes
-(KubeAPI.tla:471-483 - the Error branch fires once per true constant, see
-oracle.py), LS covers `with s \\in listRequests[self].objs` fan-out
-(KubeAPI.tla:618-629, :673-688).
+where CL = max(2, 1 + fail + timeout, LS) (see lane_layout): the fault
+switches size DoRequest's per-disjunct failure lanes (KubeAPI.tla:471-483
+- the Error branch fires once per true constant, see oracle.py), CStart's
+either needs 2, and LS covers `with s \\in listRequests[self].objs`
+fan-out (KubeAPI.tla:618-629, :673-688).
 
 Per-label handlers are ordinary jnp expressions combined with `where`
 selects on pc - no data-dependent Python control flow, so the whole step
@@ -55,9 +56,17 @@ def lane_layout(cfg: ModelConfig) -> Tuple[int, int]:
     """(CL, L): client lane-block width and total lane count.  Lane l acts
     for process l // CL when l < nc*CL, else the server.  Single source of
     truth for anything (e.g. the liveness graph builder) that must map
-    lanes back to acting processes."""
+    lanes back to acting processes.
+
+    CL is the widest per-label lane fan-out actually reachable under the
+    config's fault switches: DoRequest/DoListRequest need 1 + fail +
+    timeout lanes (KubeAPI.tla:471-483), CStart needs 2 (the either at
+    :529-531), and the `with`-fanout labels need ls (:618-629, :673-688).
+    Fault-free configs therefore run 2-wide client blocks instead of 3 -
+    a 20% lane cut that every vector phase of the engine inherits."""
     cdc = get_codec(cfg)
-    CL = max(3, cdc.ls)
+    CL = max(2, 1 + int(cfg.requests_can_fail) + int(cfg.requests_can_timeout),
+             cdc.ls)
     return CL, cdc.nc * CL + 2 * cdc.nc
 
 
@@ -207,7 +216,6 @@ def make_kernel(cfg: ModelConfig):
         lanes = []
         for status, on in ((PENDING, True), (ERROR, fail), (ERROR, timeout)):
             if not on:
-                lanes.append(INVALID)
                 continue
             nxt = set_pc(
                 {**sd, "req": sd["req"].at[i].set(req_word(op_id, obj_w, status))},
@@ -233,9 +241,6 @@ def make_kernel(cfg: ModelConfig):
         if timeout:
             erred = {**popped, "req": popped["req"].at[i].set(req_with_status(rw, ERROR))}
             lanes.append((guard, erred, jnp.bool_(False)))
-        else:
-            lanes.append(INVALID)
-        lanes.append(INVALID)
         return lanes
 
     def h_do_list_request(sd, i):
@@ -243,7 +248,6 @@ def make_kernel(cfg: ModelConfig):
         lanes = []
         for status, on in ((PENDING, True), (ERROR, fail), (ERROR, timeout)):
             if not on:
-                lanes.append(INVALID)
                 continue
             nxt = {
                 **sd,
@@ -272,9 +276,6 @@ def make_kernel(cfg: ModelConfig):
                 "lreq_obj": popped["lreq_obj"].at[i].set(jnp.zeros(ls, I32)),
             }
             lanes.append((guard, erred, jnp.bool_(False)))
-        else:
-            lanes.append(INVALID)
-        lanes.append(INVALID)
         return lanes
 
     def _branch(sd, i, cond, then_lbl, else_lbl):
@@ -533,6 +534,7 @@ def make_kernel(cfg: ModelConfig):
                 for k, lane in enumerate(hl):
                     if lane is INVALID:
                         continue
+                    assert k < CL, f"label {name} emits lane {k} >= CL={CL}"
                     v, s2, af = lane
                     cand = (mask & v, s2, aid, mask & af, jnp.bool_(False))
                     acc[k] = _sel(mask, cand, acc[k])

@@ -41,6 +41,83 @@ def test_mc_out_parses():
     assert len(ref) == 23  # Init + 22 actions (13 Client + 4 PVC + 4 proc + 1 server)
 
 
+def reference_coverage_section():
+    """MC.out's coverage dump (lines 44-1092): from the 2201 banner up to
+    the 2202 end-of-stats message."""
+    lines = []
+    on = False
+    with open(MC_OUT, "r", encoding="utf-8") as f:
+        for raw in f:
+            line = raw.rstrip("\n")
+            if line.startswith("@!@!@STARTMSG 2201:"):
+                on = True
+            if line.startswith("@!@!@STARTMSG 2202:"):
+                break
+            if on:
+                lines.append(line)
+    return lines
+
+
+def test_span_table_structure():
+    from jaxtlc.spec.coverage_spans import SPANS
+
+    assert len(SPANS) == 25  # Init + 22 actions + 2 invariants
+    n_lines = sum(len(s[3]) for s in SPANS)
+    assert n_lines == 323
+    inexact = [
+        (name, loc)
+        for name, _, _, lines in SPANS
+        for _, loc, _, _, has_cost, cexact in lines
+        if has_cost and not cexact
+    ]
+    # exactly the five TLC-internal operation tallies (module docstring)
+    assert len(inexact) == 5 and all(n == "APIStart" for n, _ in inexact)
+
+
+@pytest.mark.slow
+def test_model1_per_expression_dump_matches_mc_out():
+    """Line-for-line diff of the rendered dump against MC.out:44-1092.
+
+    Masked fields, both documented: the per-action `distinct` in 2772
+    headers (TLC's split across same-level discoverers is a worker-
+    interleaving artifact; `generated` must be exact) and the cost field
+    of the five TLC-internal operation tallies (cost_exact=False in the
+    span table)."""
+    from jaxtlc.spec.coverage import render_coverage, run_coverage
+    from jaxtlc.spec.coverage_spans import SPANS
+
+    r = run_coverage(MODEL_1)
+    assert (r.generated, r.distinct, r.depth) == (577736, 163408, 124)
+
+    ref = reference_coverage_section()
+    stamp = re.match(
+        r"The coverage statistics at (.*)$", ref[1]
+    ).group(1)
+    got = render_coverage(r, stamp)
+    assert len(got) == len(ref)
+
+    masked_cost_locs = {
+        loc
+        for _, _, _, lines in SPANS
+        for _, loc, _, _, has_cost, cexact in lines
+        if has_cost and not cexact
+    }
+    header = re.compile(r"^(<(\w+) line .*?>): (\d+):(\d+)$")
+    for i, (g, e) in enumerate(zip(got, ref)):
+        if g == e:
+            continue
+        mg, me = header.match(g), header.match(e)
+        if mg and me:  # 2772 header: distinct masked, generated exact
+            assert mg.group(1) == me.group(1), (i, g, e)
+            assert mg.group(4) == me.group(4), (i, g, e)
+            continue
+        # cost-masked line: prefix through the visit count must match
+        pref_g, _, _ = g.rpartition(":")
+        pref_e, _, _ = e.rpartition(":")
+        loc = next((l for l in masked_cost_locs if l in e), None)
+        assert loc is not None and pref_g == pref_e, (i, g, e)
+
+
 @pytest.mark.slow
 def test_model1_per_action_generated_matches_mc_out():
     ref = reference_action_coverage()

@@ -87,6 +87,7 @@ def test_invariant_kernel_flags_doctored_states():
 def test_engine_detects_seeded_assert_violation():
     """End-to-end violation path: start the engine from a state poised to
     fail the C2 assert and confirm it halts with the right code."""
+    import jax
     import jax.numpy as jnp
 
     from jaxtlc.engine.bfs import make_engine
@@ -98,10 +99,11 @@ def test_engine_detects_seeded_assert_violation():
         MODEL_1, chunk=64, queue_capacity=1 << 10, fp_capacity=1 << 12
     )
     carry = init_fn()
-    # overwrite the seeded queue with the poisoned state
+    # overwrite the seeded queue (packed rows, buffer 0) with the poison
+    packed = np.asarray(jax.jit(cdc.pack)(jnp.asarray(cdc.encode(bad))))
     queue = np.array(carry.queue)
-    queue[0] = cdc.encode(bad)
-    queue[1] = cdc.encode(bad)
+    queue[0, 0] = packed
+    queue[0, 1] = packed
     carry = carry._replace(queue=jnp.asarray(queue))
     out = run_fn(carry)
     assert int(out.viol) == VIOL_ASSERT

@@ -62,12 +62,34 @@ def test_basis_shapes():
     assert basis.dtype == np.uint32
 
 
-def test_collision_probability_matches_mc_out_scale():
-    # MC.out:41 reports 3.7E-9 *calculated* for its run; TLC's calculated
-    # estimate uses generated*distinct pairs, ours uses distinct^2 - both
-    # must land in the same order of magnitude for this run size.
-    p = collision_probability(163408)
-    assert 1e-10 < p < 1e-8
+def test_mxu_path_matches_xor_tree_and_host():
+    # the engine fingerprints via the MXU parity matmul; it must equal the
+    # XOR-tree path and the host reference bit-for-bit
+    from jaxtlc.engine.fingerprint import fp64_words_mxu
+
+    rng = np.random.default_rng(7)
+    for nbits in (108, 222, 64, 17):
+        W = (nbits + 31) // 32
+        words = rng.integers(0, 1 << 32, size=(128, W), dtype=np.uint64
+                             ).astype(np.uint32)
+        a_lo, a_hi = fp64_words(jnp.asarray(words), nbits)
+        b_lo, b_hi = fp64_words_mxu(jnp.asarray(words), nbits)
+        assert (np.asarray(a_lo) == np.asarray(b_lo)).all()
+        assert (np.asarray(a_hi) == np.asarray(b_hi)).all()
+        bits = 0
+        for w in range(W):
+            bits |= int(words[3, w]) << (32 * w)
+        ref = fp64_host(bits & ((1 << nbits) - 1), nbits)
+        assert (int(b_lo[3]) | (int(b_hi[3]) << 32)) == ref
+
+
+def test_collision_probability_matches_mc_out_exactly():
+    # MC.out:41 prints "calculated (optimistic):  val = 3.7E-9" for the
+    # committed run: distinct * (generated - distinct) / 2^64
+    p = collision_probability(577736, 163408)
+    from jaxtlc.io.tlc_log import TLCLog
+
+    assert TLCLog._efmt(p) == "3.7E-9"  # MC.out:41 verbatim
 
 
 def test_no_trivial_collisions():

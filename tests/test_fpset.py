@@ -84,6 +84,30 @@ def test_segmented_probe_partial_final_segment():
     assert not np.asarray(is_new_c).any()
 
 
+def test_mix_unmix_roundtrip_and_actual_collision():
+    from jaxtlc.engine.fpset import (
+        _mix,
+        _unmix,
+        fpset_actual_collision,
+        mix_host,
+    )
+
+    rng = np.random.default_rng(3)
+    lo = jnp.asarray(rng.integers(0, 1 << 32, 500, dtype=np.uint32))
+    hi = jnp.asarray(rng.integers(0, 1 << 32, 500, dtype=np.uint32))
+    ml, mh = _mix(lo, hi)
+    ul, uh = _unmix(ml, mh)
+    assert (np.asarray(ul) == np.asarray(lo)).all()
+    assert (np.asarray(uh) == np.asarray(hi)).all()
+    hl, hh = mix_host(int(lo[0]), int(hi[0]))
+    assert (hl, hh) == (int(ml[0]), int(mh[0]))
+
+    s = fpset_new(1 << 12)
+    s, _ = fpset_insert(s, lo, hi, jnp.ones(500, bool))
+    p = float(fpset_actual_collision(s))
+    assert 0 < p < 1  # a positive probability-scale estimate
+
+
 def test_high_load():
     s = fpset_new(1 << 10)
     vals = np.arange(700, dtype=np.uint32)

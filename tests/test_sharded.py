@@ -71,5 +71,7 @@ def test_graft_entry_single_step():
 
     fn, args = g.entry()
     out = fn(*args)
-    assert int(out.qhead) > 0  # consumed the first chunk
+    # one chunk consumed the whole 2-state init level: the engine flips to
+    # level 2 with the successors enqueued
+    assert int(out.level) == 2 and int(out.level_n) > 0
     assert int(out.generated) > 2

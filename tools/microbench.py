@@ -207,5 +207,99 @@ def main():
     fused_time(f"compare-reduce {n} into 31 bins", cmp_red, (cnt, jnp.int32(0)))
 
 
+def bench_bucket_row_layout():
+    """[nb, 16] u32 interleaved bucket rows (lo0,hi0,...,lo7,hi7) vs the
+    materialized reshape of a flat [cap, 2] table."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    cap = 1 << 26
+    nb = cap // 8
+    R = 262144
+    bid = jnp.asarray(rng.integers(0, nb, R, dtype=np.int32))
+    t16 = jnp.zeros((nb, 16), jnp.uint32)
+    t2 = jnp.zeros((cap, 2), jnp.uint32)
+
+    def g16(c):
+        t, x = c
+        r = t[(bid + x) & (nb - 1)]
+        return (t, x + r[0, 0].astype(jnp.int32) + 1)
+
+    fused_time(f"gather {R} rows [16]u32 of [nb,16]", g16, (t16, jnp.int32(0)))
+
+    def g_reshape(c):
+        t, x = c
+        r = t.reshape(nb, 8, 2)[(bid + x) & (nb - 1)]
+        return (t, x + r[0, 0, 0].astype(jnp.int32) + 1)
+
+    fused_time(f"gather {R} via reshape of flat [cap,2]", g_reshape,
+               (t2, jnp.int32(0)))
+
+    # claim scatter: two element scatters (lo col, hi col) into [nb, 16]
+    C = 262144
+    cb = jnp.asarray(rng.integers(0, nb, C, dtype=np.int32))
+    cs = jnp.asarray(rng.integers(0, 8, C, dtype=np.int32))
+    vlo = jnp.asarray(rng.integers(0, 1 << 32, C, dtype=np.uint32))
+    vhi = jnp.asarray(rng.integers(0, 1 << 32, C, dtype=np.uint32))
+
+    def sc16(c):
+        t, x = c
+        b = (cb + x) & (nb - 1)
+        t = t.at[b, 2 * cs].set(vlo)
+        t = t.at[b, 2 * cs + 1].set(vhi)
+        return (t, x + 1)
+
+    fused_time(f"2x element scatter {C} into [nb,16]", sc16, (t16, jnp.int32(0)))
+
+    rows2 = jnp.stack([vlo, vhi], 1)
+
+    def sc2(c):
+        t, x = c
+        t = t.at[((cb + x) & (nb - 1)) * 8 + cs].set(rows2)
+        return (t, x + 1)
+
+    fused_time(f"row scatter {C} into flat [cap,2]", sc2, (t2, jnp.int32(0)))
+
+
+def bench_windowed_scatter():
+    """lax.scatter of [C,2] windows into [nb,16] at (b, 2s) vs 2x element."""
+    rng = np.random.default_rng(0)
+    nb = (1 << 26) // 8
+    C = 131072
+    cb = jnp.asarray(rng.integers(0, nb, C, dtype=np.int32))
+    cs = jnp.asarray(rng.integers(0, 8, C, dtype=np.int32))
+    vlo = jnp.asarray(rng.integers(0, 1 << 32, C, dtype=np.uint32))
+    vhi = jnp.asarray(rng.integers(0, 1 << 32, C, dtype=np.uint32))
+    t16 = jnp.zeros((nb, 16), jnp.uint32)
+    rows = jnp.stack([vlo, vhi], 1)  # [C, 2]
+    dn = lax.ScatterDimensionNumbers(
+        update_window_dims=(1,), inserted_window_dims=(0,),
+        scatter_dims_to_operand_dims=(0, 1))
+
+    def scw(c):
+        t, x = c
+        idx = jnp.stack([(cb + x) & (nb - 1), 2 * cs], 1)  # [C, 2]
+        t = lax.scatter(t, idx, rows, dn,
+                        mode=lax.GatherScatterMode.FILL_OR_DROP)
+        return (t, x + 1)
+
+    fused_time(f"windowed scatter {C}x[2] into [nb,16]", scw, (t16, jnp.int32(0)))
+
+    def sc2e(c):
+        t, x = c
+        b = (cb + x) & (nb - 1)
+        t = t.at[b, 2 * cs].set(vlo)
+        t = t.at[b, 2 * cs + 1].set(vhi)
+        return (t, x + 1)
+
+    fused_time(f"2x element scatter {C} into [nb,16]", sc2e, (t16, jnp.int32(0)))
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("bench", nargs="?", default="main",
+                    choices=["main", "bucket-layout", "wscatter"])
+    which = ap.parse_args().bench
+    {"main": main, "bucket-layout": bench_bucket_row_layout,
+     "wscatter": bench_windowed_scatter}[which]()

@@ -25,7 +25,14 @@ from jax import lax
 
 from jaxtlc.config import scaled_config
 from jaxtlc.engine.fingerprint import fp64_words
-from jaxtlc.engine.fpset import BUCKET, FPSet, _bucket_of, _remap, fpset_insert
+from jaxtlc.engine.fpset import (
+    BUCKET,
+    _bucket_of,
+    _mix,
+    _remap,
+    fpset_insert,
+    fpset_new,
+)
 from jaxtlc.spec.codec import get_codec
 from jaxtlc.spec.invariants import make_invariant_kernel
 from jaxtlc.spec.kernel import initial_vectors, make_kernel
@@ -125,7 +132,7 @@ def main():
     n_fill = int(cap * args.load)
     fill_lo = rng.integers(1, 1 << 32, n_fill, dtype=np.uint32)
     fill_hi = rng.integers(0, 1 << 32, n_fill, dtype=np.uint32)
-    fps = FPSet(jnp.zeros((cap, 2), jnp.uint32))
+    fps = fpset_new(cap)
     ins = jax.jit(fpset_insert)
     CH = 1 << 20
     for i in range(0, n_fill, CH):
@@ -166,10 +173,11 @@ def main():
 
     def b_round(c):
         table, xlo = c
-        l2, h2 = _remap(xlo ^ lo, hi)
+        l2, h2 = _mix(xlo ^ lo, hi)
+        l2, h2 = _remap(l2, h2)
         bid = _bucket_of(h2, cap // BUCKET)
-        bk = table.reshape(cap // BUCKET, BUCKET, 2)[bid]
-        hit = (bk[:, :, 0] == l2[:, None]) & (bk[:, :, 1] == h2[:, None])
+        bk = table[bid]  # [R, 2B] interleaved bucket rows
+        hit = (bk[:, 0::2] == l2[:, None]) & (bk[:, 1::2] == h2[:, None])
         found = rep & hit.any(axis=1)
         return (table, xlo + jnp.uint32(1) + found[0].astype(jnp.uint32))
 
