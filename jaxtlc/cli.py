@@ -42,6 +42,10 @@ def _run_check(args) -> int:
     except (ValueError, OSError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
+    from .frontend.model import GenRunSpec
+
+    if isinstance(spec, GenRunSpec):
+        return _run_check_gen(args, spec)
     from .frontend.model import KNOWN_PROPERTIES
 
     unknown = [q for q in spec.properties if q not in KNOWN_PROPERTIES]
@@ -190,7 +194,8 @@ def _run_check(args) -> int:
             log.deadlock()
         else:
             log.msg(1000, f"Run stopped: {r.violation_name}", severity=1)
-        _print_trace(log, spec.model, args.chunk)
+        _print_trace(log, spec.model, args.chunk,
+                     trace_expr_file=args.traceExpressions)
     elif not liveness_violated:
         log.success(r.generated, r.distinct,
                     getattr(r, "actual_fp_collision", None))
@@ -218,7 +223,99 @@ def _run_check(args) -> int:
     return 13 if liveness_violated else 0  # TLC liveness exit convention
 
 
-def _print_trace(log: TLCLog, model: ModelConfig, chunk: int) -> None:
+def _run_check_gen(args, spec) -> int:
+    """Check a generic-frontend spec (E1): device engine + host liveness.
+
+    Same TLC log protocol and exit conventions as the KubeAPI path; the
+    -sharded/-checkpoint/-fpset engine variants are KubeAPI-engine knobs
+    and are rejected here (the generic engine is single-device fused)."""
+    unsupported = [
+        flag for flag, on in (
+            ("-sharded", args.sharded),
+            ("-checkpoint", args.checkpoint),
+            ("-recover", args.recover),
+            ("-fpset DiskFPSet", args.fpset != "JaxFPSet"),
+            ("-mutation", args.mutation),
+            ("-coverage", args.coverage),
+            ("-traceExpressions", args.traceExpressions),
+        ) if on
+    ]
+    if unsupported:
+        print(
+            f"Error: {', '.join(unsupported)} not supported for "
+            "generic-frontend specs yet (KubeAPI-engine knobs)",
+            file=sys.stderr,
+        )
+        return 1
+    log = TLCLog(tool_mode=not args.noTool)
+    import jax
+
+    from .gen.engine import check_gen
+    from .gen.oracle import check_leads_to, state_to_tla, violation_trace
+
+    g = spec.genspec
+    device = str(jax.devices()[0])
+    log.version(__version__)
+    log.banner(spec.fp_index, DEFAULT_SEED, spec.workers, device)
+    log.starting()
+    log.computing_init()
+    t0 = time.time()
+    r = check_gen(
+        g,
+        chunk=args.chunk,
+        queue_capacity=args.qcap,
+        fp_capacity=args.fpcap,
+        fp_index=spec.fp_index,
+        check_deadlock=spec.check_deadlock,
+    )
+    log.init_done(1)
+    violated = r.violation != 0
+    liveness_violated = False
+    if not violated and spec.properties:
+        for name, (p_ast, q_ast) in g.properties.items():
+            res = check_leads_to(g, p_ast, q_ast, name)
+            if res.holds:
+                log.msg(1000, f"Temporal property {name} holds "
+                              "(fairness: wf_next).")
+                continue
+            liveness_violated = True
+            log.msg(2116, f"Temporal properties were violated: {name}",
+                    severity=1)
+            idx = 1
+            for st in res.lasso_prefix:
+                log.trace_state(idx, None, state_to_tla(g, st))
+                idx += 1
+            log.msg(1000, "-- The following states form a cycle "
+                          "(back to the first of them) --")
+            for st in res.lasso_cycle:
+                log.trace_state(idx, None, state_to_tla(g, st))
+                idx += 1
+    if violated:
+        log.msg(2110 if r.violation >= 100 else 1000,
+                r.violation_name, severity=1)
+        found = violation_trace(g)
+        if found is None:
+            log.msg(1000, "Violation was not reproducible in host mode",
+                    severity=1)
+        else:
+            for i, (st, act) in enumerate(found[1], start=1):
+                head = (f"State {i}: <Initial predicate>" if act is None
+                        else f"State {i}: <{act}>")
+                log.msg(2217, head + "\n" + state_to_tla(g, st), severity=1)
+    elif not liveness_violated:
+        log.success(r.generated, r.distinct, None)
+        log.coverage_generic(spec.spec_name, 1, r.action_generated)
+    log.progress(r.depth, r.generated, r.distinct, r.queue_left)
+    log.final_counts(r.generated, r.distinct, r.queue_left)
+    log.depth(r.depth)
+    log.finished(int((time.time() - t0) * 1000))
+    if violated:
+        return 12
+    return 13 if liveness_violated else 0
+
+
+def _print_trace(log: TLCLog, model: ModelConfig, chunk: int,
+                 trace_expr_file: str = "") -> None:
     from .engine.trace import find_violation_trace
     from .spec.pretty import state_to_tla
 
@@ -227,8 +324,30 @@ def _print_trace(log: TLCLog, model: ModelConfig, chunk: int) -> None:
         log.msg(1000, "Violation was not reproducible in host mode", severity=1)
         return
     _, trace = found
+    expr_rows = None
+    if trace_expr_file:
+        # the Toolbox trace-explorer pass (MC_TE.out slot): evaluate each
+        # user expression in every trace state, shown as extra conjuncts.
+        # A bad/missing expression file must never lose the trace itself.
+        from .spec.pretty import value_to_tla
+        from .spec.texpr import TexprError, eval_over_trace, parse_expressions
+
+        try:
+            with open(trace_expr_file) as f:
+                exprs = parse_expressions(f.read())
+            expr_rows = eval_over_trace(exprs, trace, model)
+        except (OSError, TexprError) as e:
+            log.msg(1000, f"Trace expressions skipped: {e}", severity=1)
     for i, (st, act) in enumerate(trace, start=1):
-        log.trace_state(i, act, state_to_tla(st, model))
+        text = state_to_tla(st, model)
+        if expr_rows is not None:
+            text += "".join(
+                f"\n/\\ {res.name} = "
+                + (f"<evaluation failed: {res.value}>" if res.failed
+                   else value_to_tla(res.value))
+                for res in expr_rows[i - 1]
+            )
+        log.trace_state(i, act, text)
 
 
 def main(argv=None) -> int:
@@ -272,6 +391,12 @@ def main(argv=None) -> int:
     c.add_argument("-nodeadlock", action="store_true")
     c.add_argument("-noTool", action="store_true",
                    help="plain text output (no @!@!@ framing)")
+    c.add_argument("-traceExpressions", default="", metavar="FILE",
+                   help="trace-explorer expression file (one TLA+ "
+                        "expression per line, `Name == Expr` to name it); "
+                        "each is evaluated in every counterexample trace "
+                        "state and printed as an extra conjunct (the "
+                        "Toolbox MC_TE capability)")
     c.add_argument("-mutation", default="",
                    help="self-test: run with a deliberately broken "
                         "transition rule (e.g. delete_noop) to exercise "

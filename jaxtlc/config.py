@@ -186,7 +186,12 @@ def scaled_config():
     """
     cfg = make_scaled(n_reconcilers=2, n_binders=1, requests_can_fail=False,
                       requests_can_timeout=False)
-    # fp_capacity 4x the state count: the lockstep batched probe pays for
-    # the WORST probe chain in the batch, so load factor is kept below
-    # ~30% (measured on-chip: 59k states/s at 0.58 load vs 87k/s at 0.29)
-    return cfg, dict(chunk=4096, queue_capacity=1 << 21, fp_capacity=1 << 26)
+    # chunk 128k is the measured on-chip optimum for the v4 engine (v5e:
+    # 507k distinct/s vs 355-380k at 64k and 403k at 256k - the avg BFS
+    # level is ~104k wide, so 128k pops a whole level per step while
+    # larger chunks pay for static candidate width they can't fill).
+    # fp_capacity 4x the state count keeps end-of-run load at 0.29: the
+    # batched bucket probe pays for the worst straggler walk in the
+    # batch, and 2^27 measured SLOWER (427k/s) from table memory traffic.
+    return cfg, dict(chunk=131072, queue_capacity=1 << 21,
+                     fp_capacity=1 << 26)

@@ -144,8 +144,10 @@ def fpset_actual_collision(s: FPSet) -> jnp.ndarray:
     structured states cluster in integer space - measured min gaps ~1e2
     instead of the ~1e9 a uniform draw of this size gives - without that
     implying any XOR-collision risk)."""
-    flat = s.table.reshape(-1, 2)
-    lo, hi = flat[:, 0], flat[:, 1]
+    # read the interleaved columns directly: a [cap, 2] reshape would get a
+    # padded TPU tile layout (minor dim 2 -> 128, a 64x allocation)
+    lo = s.table[:, 0::2].reshape(-1)
+    hi = s.table[:, 1::2].reshape(-1)
     occupied = (lo != 0) | (hi != 0)
     inval = (~occupied).astype(jnp.uint32)
     s_inv, s_hi, s_lo = lax.sort((inval, hi, lo), num_keys=3)

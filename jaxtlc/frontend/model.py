@@ -25,6 +25,7 @@ import os
 from typing import List, Optional
 
 from ..config import ModelConfig
+from ..engine.fingerprint import DEFAULT_FP_INDEX
 from .launch import LaunchConfig, parse_launch_file
 from .mc_cfg import TLCConfig, parse_cfg_file
 from .mc_tla import eval_constant, parse_mc_tla_file
@@ -40,6 +41,20 @@ class RunSpec:
     properties: List[str]  # declared; liveness checking is deferred (E8)
     check_deadlock: bool
     workers: str  # "tpu" | "auto" | int-as-string
+    fp_index: int
+    spec_name: str
+    model_name: str
+
+
+@dataclasses.dataclass
+class GenRunSpec:
+    """A resolved run for the generic frontend (non-KubeAPI root spec)."""
+
+    genspec: object  # gen.ir.GenSpec
+    invariants: List[str]
+    properties: List[str]
+    check_deadlock: bool
+    workers: str
     fp_index: int
     spec_name: str
     model_name: str
@@ -76,11 +91,49 @@ def resolve(
         launch = parse_launch_file(launch_path)
 
     spec_name = launch.spec_name if launch else (extends[0] if extends else "")
+    if spec_name in ("", "KubeAPI") and not extends and not os.path.exists(
+        mc_tla_path
+    ):
+        # no MC.tla: the cfg may sit next to a bare root module
+        for f in sorted(os.listdir(model_dir)):
+            if f.endswith(".tla"):
+                spec_name = f[:-4]
+                break
     if spec_name not in ("", "KubeAPI"):
-        raise ValueError(
-            f"unsupported root spec {spec_name!r}: this engine executes the "
-            "KubeAPI action system (KubeAPI.tla:373-768); see SURVEY.md §7 "
-            "item 9 for the frontend-generality roadmap"
+        # generic frontend (E1): execute any PlusCal-translation-subset
+        # module found next to the config
+        tla_path = os.path.join(model_dir, f"{spec_name}.tla")
+        if not os.path.exists(tla_path):
+            raise ValueError(
+                f"root spec {spec_name!r}: no {spec_name}.tla next to the "
+                "config (the generic frontend loads the module from there)"
+            )
+        from ..gen.tla_parse import SpecParseError, load_genspec
+
+        try:
+            genspec = load_genspec(
+                tla_path, consts, list(cfg.invariants), list(cfg.properties)
+            )
+        except SpecParseError as e:
+            raise ValueError(
+                f"root spec {spec_name!r} is outside the supported "
+                f"PlusCal-translation subset: {e}"
+            )
+        if launch:
+            # launch-file knobs apply to generic specs exactly as to the
+            # KubeAPI path (deadlock switch, fpIndex)
+            check_deadlock = launch.check_deadlock
+            if fp_index is None:
+                fp_index = launch.fp_index
+        return GenRunSpec(
+            genspec=genspec,
+            invariants=list(cfg.invariants),
+            properties=list(cfg.properties),
+            check_deadlock=check_deadlock,
+            workers=workers,
+            fp_index=DEFAULT_FP_INDEX if fp_index is None else fp_index,
+            spec_name=spec_name,
+            model_name=os.path.basename(model_dir),
         )
     if cfg.specification not in (None, "Spec"):
         raise ValueError(f"unsupported SPECIFICATION {cfg.specification!r}")
@@ -119,7 +172,7 @@ def resolve(
         properties=properties,
         check_deadlock=check_deadlock,
         workers=workers,
-        fp_index=51 if fp_index is None else fp_index,
+        fp_index=DEFAULT_FP_INDEX if fp_index is None else fp_index,
         spec_name=spec_name or "KubeAPI",
         model_name=(launch.model_name if launch else os.path.basename(model_dir)),
     )

@@ -1,0 +1,8 @@
+"""Generic spec frontend (E1 generality, SURVEY.md §7.9).
+
+The KubeAPI path (jaxtlc.spec) executes one hand-tensorized action system.
+This package executes *any* spec written in the PlusCal-translation subset:
+a TLA+ module parser (tla_parse), a finite-domain IR (ir), a host
+interpreter (oracle), an AST -> jnp compiler (compile), and a device BFS
+engine (engine) reusing the tuned fingerprint set + MXU fingerprinting.
+"""

@@ -1,0 +1,172 @@
+"""Generic fixed-width tensor codec for GenSpec states.
+
+Every variable component (scalar, or one function entry per index value)
+is one int32 field holding the CODE of its value in the component's
+finite domain (codes = positions in the sorted domain tuple).  The packed
+wire form concatenates each field's ceil(log2 |domain|) bits into uint32
+words - the same at-rest representation the KubeAPI codec uses
+(spec/codec.py), so the MXU fingerprint path (engine.fingerprint) and the
+fingerprint set work unchanged on generic specs.
+
+Abstract values (the kernel's comparison currency): ints are themselves,
+booleans are 0/1, strings are interned ids global to the spec - so
+cross-domain `=` comparisons are value-correct.  String ORDER comparisons
+(`<` on strings) are not supported (TLC doesn't order strings either).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..spec import texpr
+from .ir import GenSpec, VarDecl
+
+
+def _bits_for(n: int) -> int:
+    return max(1, (n - 1).bit_length())
+
+
+class GenCodec:
+    def __init__(self, spec: GenSpec):
+        self.spec = spec
+        # global string intern table (abstract values for enumerants)
+        strings: List[str] = []
+        for decl in spec.variables:
+            for v in decl.domain.values:
+                if isinstance(v, str) and v not in strings:
+                    strings.append(v)
+            if decl.index_set:
+                for s in decl.index_set:
+                    if s not in strings:
+                        strings.append(s)
+        for c in spec.constants.values():
+            if isinstance(c, str) and c not in strings:
+                strings.append(c)
+            if isinstance(c, frozenset):
+                for s in c:
+                    if isinstance(s, str) and s not in strings:
+                        strings.append(s)
+        self.strings = sorted(strings)
+        self.sid = {s: i for i, s in enumerate(self.strings)}
+
+        # components: flat field layout
+        self.components: List[Tuple[str, Optional[str]]] = []
+        self.offsets: Dict[str, int] = {}
+        self.widths: List[int] = []
+        for decl in spec.variables:
+            self.offsets[decl.name] = len(self.components)
+            if decl.index_set is None:
+                self.components.append((decl.name, None))
+                self.widths.append(_bits_for(decl.domain.size))
+            else:
+                for idx in decl.index_set:
+                    self.components.append((decl.name, idx))
+                    self.widths.append(_bits_for(decl.domain.size))
+        self.n_fields = len(self.components)
+        self.nbits = sum(self.widths)
+        self.n_words = (self.nbits + 31) // 32
+
+        # per-variable abstract-value tables (code -> abstract int)
+        self.value_tables: Dict[str, np.ndarray] = {}
+        for decl in spec.variables:
+            self.value_tables[decl.name] = np.array(
+                [self.abstract(v) for v in decl.domain.values], np.int32
+            )
+
+    # -- value <-> code ---------------------------------------------------
+
+    def abstract(self, v) -> int:
+        """Abstract int of a concrete value (int/bool/str)."""
+        if isinstance(v, bool):
+            return int(v)
+        if isinstance(v, int):
+            return v
+        if isinstance(v, str):
+            if v not in self.sid:
+                raise ValueError(f"unknown string value {v!r}")
+            return self.sid[v]
+        raise ValueError(f"no abstract value for {v!r}")
+
+    def comp_index(self, var: str, idx: Optional[str]) -> int:
+        decl = self.spec.var(var)
+        off = self.offsets[var]
+        if decl.index_set is None:
+            assert idx is None
+            return off
+        return off + decl.index_set.index(idx)
+
+    def encode(self, st) -> np.ndarray:
+        """Oracle state (tuple of values / pair-tuples) -> [F] int32."""
+        out = np.zeros(self.n_fields, np.int32)
+        for decl, val in zip(self.spec.variables, st):
+            off = self.offsets[decl.name]
+            if decl.index_set is None:
+                out[off] = decl.domain.code(val)
+            else:
+                d = dict(val)
+                for j, idx in enumerate(decl.index_set):
+                    out[off + j] = decl.domain.code(d[idx])
+        return out
+
+    def decode(self, vec) -> tuple:
+        v = np.asarray(vec)
+        vals = []
+        for decl in self.spec.variables:
+            off = self.offsets[decl.name]
+            if decl.index_set is None:
+                vals.append(decl.domain.values[int(v[off])])
+            else:
+                vals.append(tuple(
+                    (idx, decl.domain.values[int(v[off + j])])
+                    for j, idx in enumerate(decl.index_set)
+                ))
+        return texpr.canon(tuple(vals))
+
+    # -- packing (same scheme as spec/codec.py pack/unpack) ---------------
+
+    def pack(self, vecs):
+        v = vecs.astype(jnp.uint32)
+        words, cur, cur_bits = [], None, 0
+        for j, width in enumerate(self.widths):
+            remaining = v[..., j]
+            rbits = width
+            while rbits > 0:
+                if cur is None:
+                    cur = jnp.zeros_like(remaining)
+                    cur_bits = 0
+                take = min(rbits, 32 - cur_bits)
+                cur = cur | (
+                    (remaining & ((jnp.uint32(1) << take) - jnp.uint32(1)))
+                    << cur_bits
+                )
+                remaining = remaining >> take
+                rbits -= take
+                cur_bits += take
+                if cur_bits == 32:
+                    words.append(cur)
+                    cur = None
+        if cur is not None:
+            words.append(cur)
+        return jnp.stack(words, axis=-1)
+
+    def unpack(self, words):
+        w = words.astype(jnp.uint32)
+        out = []
+        wi, bitpos = 0, 0
+        for width in self.widths:
+            val = jnp.zeros_like(w[..., 0])
+            got = 0
+            while got < width:
+                take = min(width - got, 32 - bitpos)
+                piece = (w[..., wi] >> bitpos) & jnp.uint32((1 << take) - 1)
+                val = val | (piece << got)
+                got += take
+                bitpos += take
+                if bitpos == 32:
+                    wi += 1
+                    bitpos = 0
+            out.append(val.astype(jnp.int32))
+        return jnp.stack(out, axis=-1)

@@ -1,0 +1,268 @@
+"""Host interpreter for GenSpec (the generic analog of spec.oracle).
+
+Independent execution path for the generic frontend: BFS over the action
+system with texpr-evaluated guards/updates, invariant checking, deadlock
+detection, and P ~> Q liveness under WF_vars(Next) (same admissible-
+behavior semantics as engine.liveness: infinite state-changing paths, or
+eternal stutter where no state-changing step is enabled).  The device
+engine (gen.engine) must reproduce these counts exactly - that is the
+differential test the KubeAPI path established (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from ..spec import texpr
+from .ir import Action, GenSpec
+
+State = Tuple  # one component per VarDecl, in declaration order
+
+
+class GenOracleResult(NamedTuple):
+    generated: int
+    distinct: int
+    depth: int
+    violations: List[Tuple[str, State]]
+    action_generated: Dict[str, int]
+    deadlocks: List[State]
+    parents: Optional[Dict[State, Tuple[Optional[State], Optional[str]]]] = (
+        None
+    )
+
+
+def state_env(spec: GenSpec, st: State) -> dict:
+    env = dict(spec.constants)
+    for decl, val in zip(spec.variables, st):
+        env[decl.name] = val
+    return env
+
+
+def _value_of(spec: GenSpec, decl, env):
+    v = env[decl.name]
+    return texpr.canon(v) if isinstance(v, (tuple, frozenset)) else v
+
+
+def initial_state(spec: GenSpec) -> State:
+    env = dict(spec.constants)
+    vals = []
+    for decl in spec.variables:
+        v = texpr.evaluate(spec.init[decl.name], env)
+        vals.append(texpr.canon(v) if isinstance(v, (tuple, frozenset))
+                    else v)
+    return tuple(vals)
+
+
+def _bindings(act: Action):
+    if act.param is None:
+        return [None]
+    return list(act.param_values)
+
+
+def successors(spec: GenSpec, st: State):
+    """[(action_label, next_state, changed)] - includes stutter successors
+    (changed=False) so deadlock semantics match TLC's (a self-loop is a
+    successor)."""
+    out = []
+    base = state_env(spec, st)
+    for act in spec.actions:
+        for b in _bindings(act):
+            env = dict(base)
+            if b is not None:
+                env[act.param] = b
+            try:
+                if not texpr.evaluate(act.guard, env):
+                    continue
+            except texpr.TexprError:
+                continue  # guard over absent structure = not enabled
+            vals = []
+            for decl in spec.variables:
+                upd = act.updates.get(decl.name)
+                if upd is None:
+                    vals.append(env[decl.name])
+                else:
+                    v = texpr.evaluate(upd, env)
+                    vals.append(
+                        texpr.canon(v) if isinstance(v, (tuple, frozenset))
+                        else v
+                    )
+            nxt = tuple(vals)
+            label = act.name if b is None else f"{act.name}({b})"
+            out.append((label, nxt, nxt != st))
+    return out
+
+
+def bfs(spec: GenSpec, max_states: int = 5_000_000,
+        check_deadlock: bool = True,
+        keep_parents: bool = False) -> GenOracleResult:
+    init = initial_state(spec)
+    seen = {init: 0}
+    parents: Optional[Dict] = {init: (None, None)} if keep_parents else None
+    frontier = deque([init])
+    generated = 1
+    depth = 1
+    violations: List[Tuple[str, State]] = []
+    act_gen: Dict[str, int] = {}
+    deadlocks: List[State] = []
+    for name, ast in spec.invariants.items():
+        if not texpr.evaluate(ast, state_env(spec, init)):
+            violations.append((name, init))
+    while frontier and not violations:
+        st = frontier.popleft()
+        succs = successors(spec, st)
+        if check_deadlock and not succs:
+            deadlocks.append(st)
+            violations.append(("Deadlock", st))
+            break
+        for label, nxt, _changed in succs:
+            generated += 1
+            base = label.split("(")[0]
+            act_gen[base] = act_gen.get(base, 0) + 1
+            if nxt in seen:
+                continue
+            if len(seen) >= max_states:
+                raise RuntimeError("state-space bound exceeded")
+            seen[nxt] = seen[st] + 1
+            depth = max(depth, seen[nxt] + 1)
+            if keep_parents:
+                parents[nxt] = (st, label)
+            for name, ast in spec.invariants.items():
+                if not texpr.evaluate(ast, state_env(spec, nxt)):
+                    violations.append((name, nxt))
+            if violations:
+                break
+            frontier.append(nxt)
+    return GenOracleResult(
+        generated=generated,
+        distinct=len(seen),
+        depth=depth,
+        violations=violations,
+        action_generated=act_gen,
+        deadlocks=deadlocks,
+        parents=parents,
+    )
+
+
+def violation_trace(spec: GenSpec, max_states: int = 5_000_000):
+    """Host re-run -> (kind, [(state, action_label or None), ...]) for the
+    first violation, or None if clean (the generic trace-explorer path)."""
+    r = bfs(spec, max_states=max_states, keep_parents=True)
+    if not r.violations:
+        return None
+    kind, bad = r.violations[0]
+    chain = []
+    cur = bad
+    while cur is not None:
+        parent, label = r.parents[cur]
+        chain.append((cur, label))
+        cur = parent
+    chain.reverse()
+    return kind, chain
+
+
+def state_to_tla(spec: GenSpec, st: State) -> str:
+    """TLA-conjunct rendering of a generic state (TLC trace style)."""
+    from ..spec.pretty import value_to_tla
+
+    return "\n".join(
+        f"/\\ {decl.name} = {value_to_tla(val)}"
+        for decl, val in zip(spec.variables, st)
+    )
+
+
+class LivenessResult(NamedTuple):
+    name: str
+    holds: bool
+    lasso_prefix: Optional[List[State]]
+    lasso_cycle: Optional[List[State]]
+
+
+def check_leads_to(spec: GenSpec, p_ast, q_ast, name: str = "",
+                   max_states: int = 1_000_000) -> LivenessResult:
+    """P ~> Q under WF_vars(Next) on the reachable graph.
+
+    survive(s) iff ~Q(s) and (no state-changing successor at all, or some
+    state-changing successor survives) - greatest fixpoint by peeling; a
+    violation is a reachable surviving state satisfying P (the lasso is
+    prefix + a cycle/terminal tail inside ~Q)."""
+    init = initial_state(spec)
+    states = {init: 0}
+    order = [init]
+    edges: Dict[int, List[int]] = {}
+    frontier = deque([init])
+    while frontier:
+        st = frontier.popleft()
+        sid = states[st]
+        outs = []
+        for _, nxt, changed in successors(spec, st):
+            if not changed:
+                continue
+            if nxt not in states:
+                if len(states) >= max_states:
+                    raise RuntimeError("liveness graph bound exceeded")
+                states[nxt] = len(order)
+                order.append(nxt)
+                frontier.append(nxt)
+            outs.append(states[nxt])
+        edges[sid] = outs
+    n = len(order)
+    in_h = [not texpr.evaluate(q_ast, state_env(spec, s)) for s in order]
+    # peel: alive = in_h; repeatedly drop states whose every state-changing
+    # successor is dead, unless they have no state-changing successor
+    alive = list(in_h)
+    changed_flag = True
+    while changed_flag:
+        changed_flag = False
+        for i in range(n):
+            if not alive[i]:
+                continue
+            outs = edges[i]
+            if outs and not any(alive[j] for j in outs):
+                alive[i] = False
+                changed_flag = True
+    for i in range(n):
+        if alive[i] and texpr.evaluate(p_ast, state_env(spec, order[i])):
+            # build prefix init -> i (BFS parent walk), cycle inside alive
+            prefix = _path_to(edges, 0, i, n)
+            cycle = _alive_tail(edges, i, alive)
+            return LivenessResult(
+                name, False,
+                [order[j] for j in prefix],
+                [order[j] for j in cycle],
+            )
+    return LivenessResult(name, True, None, None)
+
+
+def _path_to(edges, src, dst, n):
+    prev = {src: None}
+    q = deque([src])
+    while q:
+        u = q.popleft()
+        if u == dst:
+            break
+        for v in edges[u]:
+            if v not in prev:
+                prev[v] = u
+                q.append(v)
+    path, cur = [], dst
+    while cur is not None:
+        path.append(cur)
+        cur = prev[cur]
+    return list(reversed(path))
+
+
+def _alive_tail(edges, start, alive):
+    """A cycle (or terminal tail) within the surviving set from start."""
+    seen = {start: 0}
+    seq = [start]
+    cur = start
+    while True:
+        outs = [j for j in edges[cur] if alive[j]]
+        if not outs:
+            return seq  # terminal stutter tail
+        cur = outs[0]
+        if cur in seen:
+            return seq[seen[cur]:]
+        seen[cur] = len(seq)
+        seq.append(cur)

@@ -156,6 +156,20 @@ class TLCLog:
                 f"col {len(name) + 6} of module KubeAPI>: {d}:{g}",
             )
 
+    def coverage_generic(self, module: str, init_count: int,
+                         act_gen: Dict[str, int]) -> None:
+        """Per-action coverage for generic-frontend specs: the module's own
+        action names (no hardcoded span table; spans need the module's
+        source map, which the generic parser doesn't keep yet)."""
+        self.msg(
+            2201,
+            f"The coverage statistics at {time.strftime('%Y-%m-%d %H:%M:%S')}",
+        )
+        self.msg(2773, f"<Init of module {module}>: "
+                       f"{init_count}:{init_count}")
+        for name, g in act_gen.items():
+            self.msg(2772, f"<{name} of module {module}>: {g}")
+
     def final_counts(self, generated: int, distinct: int, queue: int) -> None:
         self.msg(
             2199,

@@ -17,6 +17,11 @@ from .labels import DEFAULT_INIT
 from .oracle import State
 
 
+def value_to_tla(v) -> str:
+    """Public value renderer (trace-expression output uses it)."""
+    return _value(v)
+
+
 def _value(v) -> str:
     if v is None:
         return "defaultInitValue"

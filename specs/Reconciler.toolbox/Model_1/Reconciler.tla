@@ -1,0 +1,78 @@
+---- MODULE Reconciler ----
+\* Multi-controller Kubernetes reconcile-loop spec (the second config
+\* family from BASELINE.json: "Kubernetes reconciler/controller-loop spec
+\* (multi-controller safety+liveness)").  N level-triggered controllers
+\* race to drive `applied` to the user's `desired` generation; each runs
+\* the observe-then-apply loop, so a controller can apply a STALE
+\* observation after the user bumps desired again - the classic
+\* reconcile race the spec makes checkable.
+\*
+\* Written in the PlusCal-translation subset the jaxtlc generic frontend
+\* executes (pc-guarded actions, one-level functions over a finite
+\* process set, EXCEPT updates, bounded quantifiers).
+EXTENDS Naturals
+
+CONSTANTS Controllers, MaxGen
+
+VARIABLES desired, observed, applied, pc
+
+vars == << desired, observed, applied, pc >>
+
+TypeOK == /\ desired \in 0..MaxGen
+          /\ observed \in [Controllers -> 0..MaxGen]
+          /\ applied \in [Controllers -> 0..MaxGen]
+          /\ pc \in [Controllers -> {"Idle", "Observe", "Apply"}]
+
+Init == /\ desired = 0
+        /\ observed = [self \in Controllers |-> 0]
+        /\ applied = [self \in Controllers |-> 0]
+        /\ pc = [self \in Controllers |-> "Idle"]
+
+\* The user bumps the desired generation (at any time, bounded by MaxGen).
+Bump == /\ desired < MaxGen
+        /\ desired' = desired + 1
+        /\ UNCHANGED << observed, applied, pc >>
+
+\* A controller notices drift and starts a reconcile cycle.
+Wake(self) == /\ pc[self] = "Idle"
+              /\ applied[self] # desired
+              /\ pc' = [pc EXCEPT ![self] = "Observe"]
+              /\ UNCHANGED << desired, observed, applied >>
+
+\* It reads the current desired state (the watch/list step).
+Observe(self) == /\ pc[self] = "Observe"
+                 /\ observed' = [observed EXCEPT ![self] = desired]
+                 /\ pc' = [pc EXCEPT ![self] = "Apply"]
+                 /\ UNCHANGED << desired, applied >>
+
+\* It applies what it OBSERVED - possibly stale by now (the race).
+Apply(self) == /\ pc[self] = "Apply"
+               /\ applied' = [applied EXCEPT ![self] = observed[self]]
+               /\ pc' = [pc EXCEPT ![self] = "Idle"]
+               /\ UNCHANGED << desired, observed >>
+
+ctrl(self) == Wake(self) \/ Observe(self) \/ Apply(self)
+
+\* Converged-state stutter so the final fixpoint is not a TLC deadlock
+\* (the PlusCal "Terminating" convention).
+Terminating == /\ desired = MaxGen
+               /\ \A self \in Controllers : applied[self] = MaxGen
+               /\ \A self \in Controllers : pc[self] = "Idle"
+               /\ UNCHANGED vars
+
+Next == Bump \/ Terminating \/ (\E self \in Controllers : ctrl(self))
+
+Spec == Init /\ [][Next]_vars /\ WF_vars(Next)
+
+\* Safety: a controller never applies a generation the user hasn't asked
+\* for (applied only ever copies an observation of desired, and desired
+\* is monotone).
+AppliedBounded == \A self \in Controllers : applied[self] <= desired
+
+\* A controller mid-cycle holds an observation no newer than desired.
+ObservedBounded == \A self \in Controllers : observed[self] <= desired
+
+\* Liveness: drift is eventually reconciled (weak fairness of Next).
+Converges == \A self \in Controllers :
+               (applied[self] # desired) ~> (applied[self] = desired)
+====

@@ -59,10 +59,26 @@ def test_resolve_reference_model():
     assert spec.model_name == "Model_1"
 
 
-def test_resolve_rejects_unknown_spec(tmp_path):
+def test_resolve_unknown_spec_needs_module_file(tmp_path):
+    # non-KubeAPI root specs now route to the generic frontend (E1), which
+    # needs the module source next to the config
     (tmp_path / "MC.cfg").write_text("SPECIFICATION Spec\n")
     (tmp_path / "MC.tla").write_text(
         "---- MODULE MC ----\nEXTENDS Raft, TLC\n====\n"
     )
-    with pytest.raises(ValueError, match="unsupported root spec"):
+    with pytest.raises(ValueError, match="no Raft.tla next to the config"):
+        resolve(str(tmp_path / "MC.cfg"))
+
+
+def test_resolve_unknown_spec_outside_subset(tmp_path):
+    # a module the generic parser cannot handle is a clear subset error
+    (tmp_path / "MC.cfg").write_text("SPECIFICATION Spec\n")
+    (tmp_path / "MC.tla").write_text(
+        "---- MODULE MC ----\nEXTENDS Raft, TLC\n====\n"
+    )
+    (tmp_path / "Raft.tla").write_text(
+        "---- MODULE Raft ----\nVARIABLES log\n"
+        "Init == log = CHOOSE x \\in {} : TRUE\n====\n"
+    )
+    with pytest.raises(ValueError, match="PlusCal-translation subset"):
         resolve(str(tmp_path / "MC.cfg"))

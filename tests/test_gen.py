@@ -1,0 +1,295 @@
+"""Generic spec frontend tests (E1 generality, VERDICT r3 item 6): the
+Reconciler controller-loop spec (the second BASELINE.json config family)
+checked end-to-end - parser structure, host-oracle counts, compiled-kernel
+differential vs the oracle on every reachable state, device-engine parity,
+invariant-violation traces, leads-to liveness, and the CLI contract."""
+
+import os
+
+import numpy as np
+import pytest
+
+SPEC_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "specs", "Reconciler.toolbox", "Model_1",
+)
+TLA = os.path.join(SPEC_DIR, "Reconciler.tla")
+CFG = os.path.join(SPEC_DIR, "MC.cfg")
+
+# oracle-pinned counts for Controllers={c1,c2}, MaxGen=2
+EXPECT = (155, 81, 13)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    from jaxtlc.frontend.mc_cfg import parse_cfg_file
+    from jaxtlc.gen.tla_parse import load_genspec
+
+    cfg = parse_cfg_file(CFG)
+    return load_genspec(TLA, cfg.constants, cfg.invariants, cfg.properties)
+
+
+def test_parse_structure(spec):
+    assert spec.name == "Reconciler"
+    assert [v.name for v in spec.variables] == [
+        "desired", "observed", "applied", "pc"
+    ]
+    assert spec.var("desired").index_set is None
+    assert spec.var("pc").index_set == ("c1", "c2")
+    assert spec.var("pc").domain.values == ("Apply", "Idle", "Observe")
+    names = [a.name for a in spec.actions]
+    assert names == ["Bump", "Terminating", "Wake", "Observe", "Apply"]
+    assert spec.actions[2].param == "self"
+    assert spec.actions[2].param_values == ("c1", "c2")
+    assert set(spec.invariants) == {
+        "TypeOK", "AppliedBounded", "ObservedBounded"
+    }
+    assert set(spec.properties) == {"Converges[c1]", "Converges[c2]"}
+
+
+def test_oracle_counts(spec):
+    from jaxtlc.gen import oracle as go
+
+    r = go.bfs(spec)
+    assert (r.generated, r.distinct, r.depth) == EXPECT
+    assert not r.violations
+
+
+def test_kernel_differential_all_states(spec):
+    """The compiled lane kernel must reproduce the oracle's successor sets
+    (labels + states) on EVERY reachable state - the same differential
+    the KubeAPI kernel is held to (tests/test_engine.py)."""
+    from collections import deque
+
+    import jax
+    import jax.numpy as jnp
+
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.gen.codec import GenCodec
+    from jaxtlc.gen.kernel import make_gen_kernel
+
+    cdc = GenCodec(spec)
+    ker = make_gen_kernel(spec, cdc)
+    init = go.initial_state(spec)
+    seen = {init}
+    q = deque([init])
+    states = []
+    while q:
+        st = q.popleft()
+        states.append(st)
+        for _, nxt, _ in go.successors(spec, st):
+            if nxt not in seen:
+                seen.add(nxt)
+                q.append(nxt)
+    mat = jnp.asarray(np.stack([cdc.encode(s) for s in states]))
+    succs, valid, ovf = map(np.asarray, jax.jit(jax.vmap(ker.step))(mat))
+    assert not ovf.any()
+    for i, st in enumerate(states):
+        o = sorted((lbl, nxt) for lbl, nxt, _ in go.successors(spec, st))
+        d = sorted(
+            (ker.lane_labels[l], cdc.decode(succs[i, l]))
+            for l in range(ker.n_lanes) if valid[i, l]
+        )
+        assert o == d, f"successor mismatch at {st}"
+    # codec roundtrip over the full space
+    for s in states:
+        assert cdc.decode(cdc.encode(s)) == s
+
+
+def test_device_engine_parity(spec):
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.gen.engine import check_gen
+
+    r = check_gen(spec, chunk=64, queue_capacity=1 << 10,
+                  fp_capacity=1 << 12)
+    o = go.bfs(spec)
+    assert (r.generated, r.distinct, r.depth) == EXPECT
+    assert r.violation == 0 and r.queue_left == 0
+    assert r.action_generated == o.action_generated
+
+
+def test_invariant_violation_and_trace(tmp_path):
+    """A false invariant must be caught by the device engine AND yield an
+    initial-state-rooted trace from the host re-run."""
+    from jaxtlc.frontend.mc_cfg import parse_cfg_file
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.gen.engine import check_gen
+    from jaxtlc.gen.tla_parse import load_genspec
+
+    with open(TLA) as f:
+        text = f.read()
+    text = text.replace(
+        "====",
+        "NeverObserves == \\A self \\in Controllers : observed[self] = 0\n"
+        "====",
+    )
+    p = tmp_path / "Reconciler.tla"
+    p.write_text(text)
+    cfg = parse_cfg_file(CFG)
+    spec = load_genspec(str(p), cfg.constants,
+                        cfg.invariants + ["NeverObserves"], [])
+    r = check_gen(spec, chunk=64, queue_capacity=1 << 10,
+                  fp_capacity=1 << 12)
+    assert r.violation >= 100
+    assert "NeverObserves" in r.violation_name
+    found = go.violation_trace(spec)
+    assert found is not None
+    kind, chain = found
+    assert kind == "NeverObserves"
+    assert chain[0][1] is None  # starts at the initial state
+    assert len(chain) >= 2
+    # the violating state really violates it
+    from jaxtlc.spec import texpr
+
+    last = chain[-1][0]
+    assert not texpr.evaluate(
+        spec.invariants["NeverObserves"], go.state_env(spec, last)
+    )
+    # and every step is a real oracle transition
+    for (prev, _), (cur, lbl) in zip(chain, chain[1:]):
+        assert any(
+            nxt == cur and label == lbl
+            for label, nxt, _ in go.successors(spec, prev)
+        )
+
+
+def test_liveness_holds_and_violated(spec):
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.spec import texpr
+
+    for name, (p, q) in spec.properties.items():
+        res = go.check_leads_to(spec, p, q, name)
+        assert res.holds, name
+    # an unsatisfiable leads-to must be reported with a lasso
+    p_ast = texpr.parse("desired = 0")
+    q_ast = texpr.parse("desired = 3")
+    res = go.check_leads_to(spec, p_ast, q_ast, "Never")
+    assert not res.holds
+    assert res.lasso_prefix and res.lasso_cycle
+    # the lasso stays inside ~Q
+    for st in res.lasso_cycle:
+        assert not texpr.evaluate(q_ast, go.state_env(spec, st))
+
+
+def test_scaled_reconciler_parity():
+    """Bigger instance (3 controllers, MaxGen 3): parser constants come
+    from a cfg variant; device == oracle exactly."""
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.gen.engine import check_gen
+    from jaxtlc.gen.tla_parse import load_genspec
+
+    spec = load_genspec(
+        TLA,
+        {"Controllers": "{c1, c2, c3}", "MaxGen": "3"},
+        ["TypeOK", "AppliedBounded", "ObservedBounded"],
+        [],
+    )
+    o = go.bfs(spec)
+    r = check_gen(spec, chunk=256, queue_capacity=1 << 12,
+                  fp_capacity=1 << 15)
+    assert (r.generated, r.distinct, r.depth) == (
+        o.generated, o.distinct, o.depth
+    )
+    assert not o.violations and r.violation == 0
+    assert r.action_generated == o.action_generated
+
+
+def test_expr_precedence_or_loosest(spec):
+    """`a \\/ b /\\ c` must parse as or(a, and(b, c)) - the top-level
+    splitter must cut \\/ before /\\ (review r4 finding)."""
+    from jaxtlc.frontend.mc_cfg import parse_cfg_file
+    from jaxtlc.gen.tla_parse import ModuleParser
+
+    cfg = parse_cfg_file(CFG)
+    with open(TLA) as f:
+        mp = ModuleParser(f.read(), {"Controllers": frozenset({"c1"}),
+                                     "MaxGen": 2},
+                          [], [])
+    ast = mp.expr("desired = 1 \\/ desired = 2 /\\ desired = 3")
+    assert ast[0] == "or"
+    assert ast[2][0] == "and"
+
+
+def test_kernel_rejects_cross_type_equality():
+    """int-vs-string `=` must be a compile error, not an intern-id alias
+    (review r4 finding: device/host divergence)."""
+    import pytest as _pytest
+
+    from jaxtlc.gen.kernel import CompileError, make_gen_kernel
+    from jaxtlc.gen.codec import GenCodec
+    from jaxtlc.gen.tla_parse import load_genspec
+
+    spec = load_genspec(
+        TLA, {"Controllers": "{c1}", "MaxGen": "1"},
+        ["TypeOK"], [],
+    )
+    # sneak a cross-type invariant in
+    import dataclasses
+
+    from jaxtlc.spec import texpr
+
+    bad = dict(spec.invariants)
+    bad["Bad"] = texpr.parse('desired = "Idle"')
+    spec = dataclasses.replace(spec, invariants=bad)
+    with _pytest.raises(CompileError, match="cannot compare"):
+        make_gen_kernel(spec, GenCodec(spec))
+
+
+def test_property_with_compound_parens(tmp_path):
+    """((P1) \\/ (P2)) ~> (Q) must parse (review r4 finding: strip('()')
+    mangled unmatched parens)."""
+    from jaxtlc.gen.tla_parse import load_genspec
+
+    with open(TLA) as f:
+        text = f.read()
+    text = text.replace(
+        "====",
+        "EitherConverges == ((applied[\"c1\"] = desired) \\/ "
+        "(applied[\"c2\"] = desired)) ~> (desired = MaxGen)\n====",
+    )
+    p = tmp_path / "Reconciler.tla"
+    p.write_text(text)
+    spec = load_genspec(str(p), {"Controllers": "{c1, c2}", "MaxGen": "2"},
+                        ["TypeOK"], ["EitherConverges"])
+    assert "EitherConverges" in spec.properties
+
+
+def test_cli_generic_spec(capsys):
+    from jaxtlc.cli import main
+
+    rc = main(["check", CFG, "-noTool", "-chunk", "64", "-qcap", "1024",
+               "-fpcap", "4096"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "155 states generated, 81 distinct states found" in out
+    assert "The depth of the complete state graph search is 13." in out
+    assert "Temporal property Converges[c1] holds" in out
+    assert "Temporal property Converges[c2] holds" in out
+    assert "<Bump of module Reconciler>" in out
+    assert "No error has been found" in out
+
+
+def test_cli_generic_invariant_violation(tmp_path, capsys):
+    from jaxtlc.cli import main
+
+    with open(TLA) as f:
+        text = f.read()
+    text = text.replace(
+        "====",
+        "NeverObserves == \\A self \\in Controllers : observed[self] = 0\n"
+        "====",
+    )
+    d = tmp_path / "Model_1"
+    d.mkdir()
+    (d / "Reconciler.tla").write_text(text)
+    (d / "MC.cfg").write_text(
+        "CONSTANT Controllers = {c1, c2}\nCONSTANT MaxGen = 2\n"
+        "SPECIFICATION Spec\nINVARIANT TypeOK\nINVARIANT NeverObserves\n"
+    )
+    rc = main(["check", str(d / "MC.cfg"), "-noTool", "-chunk", "64",
+               "-qcap", "1024", "-fpcap", "4096"])
+    out = capsys.readouterr().out
+    assert rc == 12
+    assert "NeverObserves" in out
+    assert "State 1: <Initial predicate>" in out
+    assert "/\\ desired = " in out

@@ -1,0 +1,227 @@
+"""Trace-expression evaluation tests (E11 trace-explorer re-evaluation,
+the MC_TE.out capability): parser/evaluator unit tests over real oracle
+states, per-trace-state evaluation incl. primed variables, and the e2e CLI
+flag on a mutation-induced counterexample."""
+
+import pytest
+
+from jaxtlc.config import ModelConfig
+from jaxtlc.spec import oracle
+from jaxtlc.spec.texpr import (
+    TexprError,
+    eval_over_trace,
+    evaluate,
+    parse,
+    parse_expressions,
+    state_env,
+)
+
+FF = ModelConfig(False, False)
+
+
+@pytest.fixture(scope="module")
+def init_env():
+    sts = oracle.initial_states(FF)
+    # the initial state with shouldReconcile[Client] = TRUE
+    st = next(s for s in sts if s.should_reconcile[0])
+    return state_env(st, FF)
+
+
+def test_variable_and_literals(init_env):
+    assert evaluate(parse("apiState = {}"), init_env) is True
+    assert evaluate(parse("Cardinality(apiState) = 0"), init_env) is True
+    assert evaluate(parse('pc["Client"]'), init_env) == "CStart"
+    assert evaluate(parse("shouldReconcile[\"Client\"]"), init_env) is True
+
+
+def test_boolean_operators(init_env):
+    assert evaluate(parse("TRUE /\\ ~FALSE"), init_env) is True
+    assert evaluate(parse("FALSE \\/ TRUE"), init_env) is True
+    assert evaluate(parse("FALSE => FALSE"), init_env) is True
+    assert evaluate(parse("1 = 1 /\\ 2 # 3"), init_env) is True
+
+
+def test_set_operators(init_env):
+    assert evaluate(parse("{1, 2} \\cup {3} = {1, 2, 3}"), init_env) is True
+    assert evaluate(parse("{1, 2} \\cap {2, 3} = {2}"), init_env) is True
+    assert evaluate(parse("{1, 2} \\ {2} = {1}"), init_env) is True
+    assert evaluate(parse("2 \\in {1, 2}"), init_env) is True
+    assert evaluate(parse("5 \\notin {1, 2}"), init_env) is True
+    assert evaluate(parse("{1} \\subseteq {1, 2}"), init_env) is True
+
+
+def test_arithmetic_and_comparisons(init_env):
+    assert evaluate(parse("1 + 2 = 3"), init_env) is True
+    assert evaluate(parse("5 - 2 >= 3"), init_env) is True
+    assert evaluate(parse("2 < 3 /\\ 3 <= 3 /\\ 4 > 3"), init_env) is True
+
+
+def test_records_and_sequences(init_env):
+    assert evaluate(
+        parse('[kind |-> "PVC", name |-> "foo"].kind'), init_env
+    ) == "PVC"
+    assert evaluate(parse("Len(<<1, 2, 3>>) = 3"), init_env) is True
+    assert evaluate(parse("<<4, 5>>[2] = 5"), init_env) is True
+
+
+def test_record_membership_in_real_state():
+    # drive the oracle one step and check apiState membership syntax on a
+    # state where the server has objects
+    sts = oracle.initial_states(FF)
+    frontier = list(sts)
+    target = None
+    for _ in range(12):
+        nxt = []
+        for s in frontier:
+            for x in oracle.successors(s, FF):
+                nxt.append(x.state)
+                if len(x.state.api_state) >= 1:
+                    target = x.state
+        if target:
+            break
+        frontier = nxt[:50]
+    assert target is not None
+    env = state_env(target, FF)
+    assert evaluate(parse("Cardinality(apiState) >= 1"), env) is True
+    rec = next(iter(target.api_state))
+    fields = dict(rec)
+    from jaxtlc.spec.pretty import value_to_tla
+
+    lit = value_to_tla(rec)
+    assert evaluate(parse(f"{lit} \\in apiState"), env) is True
+    assert evaluate(parse(f'{lit}.k = "{fields["k"]}"'), env) is True
+
+
+def test_errors_are_reported():
+    env = state_env(oracle.initial_states(FF)[0], FF)
+    with pytest.raises(TexprError):
+        evaluate(parse("nosuchvar = 1"), env)
+    with pytest.raises(TexprError):
+        evaluate(parse('pc["NoSuchProc"]'), env)
+    with pytest.raises(TexprError):
+        parse("{1, ")
+
+
+def test_parse_expressions_named_and_bare():
+    exprs = parse_expressions(
+        "\\* comment line\n"
+        "NObjects == Cardinality(apiState)\n"
+        "\n"
+        "pc[\"Client\"] = \"CStart\"\n"
+    )
+    assert [e.name for e in exprs] == ["NObjects", 'pc["Client"] = "CStart"']
+
+
+def test_eval_over_trace_primes():
+    from jaxtlc.engine.trace import find_violation_trace
+
+    broken = ModelConfig(False, False, mutation="delete_noop")
+    kind, trace = find_violation_trace(broken, chunk=256)
+    exprs = parse_expressions(
+        "NObj == Cardinality(apiState)\n"
+        "Grew == Cardinality(apiState') >= Cardinality(apiState)\n"
+        "PC == pc[\"Client\"]\n"
+    )
+    rows = eval_over_trace(exprs, trace, broken)
+    assert len(rows) == len(trace)
+    for row in rows:
+        d = {r.name: r.value for r in row}
+        assert not any(r.failed for r in row)
+        assert isinstance(d["NObj"], int)
+        assert isinstance(d["Grew"], bool)
+        assert isinstance(d["PC"], str)
+    # primes: NObj' of state i equals NObj of state i+1
+    for i in range(len(rows) - 1):
+        grew = {r.name: r.value for r in rows[i]}["Grew"]
+        n_i = {r.name: r.value for r in rows[i]}["NObj"]
+        n_n = {r.name: r.value for r in rows[i + 1]}["NObj"]
+        assert grew == (n_n >= n_i)
+
+
+def test_type_errors_degrade_not_crash():
+    # a mis-typed expression must yield a failed ExprResult, not a crash
+    from jaxtlc.engine.trace import find_violation_trace
+
+    broken = ModelConfig(False, False, mutation="delete_noop")
+    _, trace = find_violation_trace(broken, chunk=256)
+    exprs = parse_expressions('Bad == pc["Client"] < 3\n'
+                              "Good == Cardinality(apiState)\n")
+    rows = eval_over_trace(exprs, trace[:2], broken)
+    for row in rows:
+        by = {r.name: r for r in row}
+        assert by["Bad"].failed
+        assert not by["Good"].failed
+
+
+def test_quantifiers_ranges_except(init_env):
+    assert evaluate(parse("\\A x \\in 1..3 : x <= 3"), init_env) is True
+    assert evaluate(parse("\\E x \\in {1, 5} : x > 4"), init_env) is True
+    assert evaluate(parse("\\A x \\in {} : FALSE"), init_env) is True
+    assert evaluate(parse("1..3 = {1, 2, 3}"), init_env) is True
+    assert evaluate(parse("0..2-1 = {0, 1}"), init_env) is True  # ..loose
+    # function literal over strings, and EXCEPT with @
+    assert evaluate(
+        parse('[x \\in {"a", "b"} |-> 0]["b"]'), init_env
+    ) == 0
+    assert evaluate(
+        parse('[[x \\in {"a", "b"} |-> 1] EXCEPT !["a"] = @ + 5]["a"]'),
+        init_env,
+    ) == 6
+    assert evaluate(
+        parse('[[x \\in {"a", "b"} |-> 1] EXCEPT !["a"] = 9]["b"]'),
+        init_env,
+    ) == 1
+    # EXCEPT on a sequence (1-indexed)
+    assert evaluate(
+        parse("[<<7, 8>> EXCEPT ![2] = 0]"), init_env
+    ) == (7, 0)
+    # quantifier over a state variable's domain-style set
+    assert evaluate(
+        parse("\\A x \\in apiState : FALSE"), init_env
+    ) is True  # empty apiState
+
+
+def test_sequence_of_pairs_is_not_a_function():
+    env = state_env(oracle.initial_states(FF)[0], FF)
+    # a sequence whose elements happen to be 2-tuples indexes positionally
+    assert evaluate(parse("<<<<1, 2>>, <<3, 4>>>>[1]"), env) == (1, 2)
+    assert evaluate(parse("<<<<1, 2>>, <<3, 4>>>>[2][2]"), env) == 4
+
+
+def test_cli_trace_expressions(tmp_path, capsys):
+    from jaxtlc.cli import main
+
+    d = tmp_path / "Model_FF"
+    d.mkdir()
+    (d / "MC.tla").write_text(
+        "---- MODULE MC ----\nEXTENDS KubeAPI, TLC\n"
+        "\\* CONSTANT definitions @modelParameterConstants:1REQUESTS_CAN_FAIL\n"
+        "const_fail ==\nFALSE\n"
+        "\\* CONSTANT definitions @modelParameterConstants:2REQUESTS_CAN_TIMEOUT\n"
+        "const_to ==\nFALSE\n====\n"
+    )
+    (d / "MC.cfg").write_text(
+        "CONSTANT defaultInitValue = defaultInitValue\n"
+        "CONSTANT REQUESTS_CAN_FAIL <- const_fail\n"
+        "CONSTANT REQUESTS_CAN_TIMEOUT <- const_to\n"
+        "SPECIFICATION Spec\nINVARIANT TypeOK\nINVARIANT OnlyOneVersion\n"
+    )
+    te = tmp_path / "trace_exprs.txt"
+    te.write_text("NObjects == Cardinality(apiState)\n"
+                  "ClientPC == pc[\"Client\"]\n")
+    rc = main(
+        ["check", str(d / "MC.cfg"), "-noTool", "-mutation", "delete_noop",
+         "-traceExpressions", str(te), "-chunk", "128", "-qcap", "4096",
+         "-fpcap", "16384"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 12
+    assert "/\\ NObjects = " in out
+    assert '/\\ ClientPC = "' in out
+    # every trace state carries the expression conjuncts
+    import re
+
+    n_states = len(re.findall(r"^State \d+: ", out, re.M))
+    assert n_states > 0
+    assert out.count("/\\ NObjects = ") == n_states
+    assert out.count('/\\ ClientPC = "') == n_states

@@ -1,0 +1,201 @@
+"""Per-phase cost microbench at the REAL scaled-run shapes (chunk 128k,
+L=12, ncand 1.57M, R=C=A=256k, fp table 2^26).  Complements microbench.py
+(which measured primitive costs at smaller shapes) - this one prices the
+exact step_body phases so optimization targets the measured sink, not a
+guessed one."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+K = 16
+
+
+def fused_time(name, body, carry, reps=3):
+    @jax.jit
+    def loop(c):
+        return lax.fori_loop(0, K, lambda _, cc: body(cc), c)
+
+    out = jax.block_until_ready(loop(carry))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(loop(carry))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:52s} {best / K * 1e3:9.3f} ms", flush=True)
+    return out
+
+
+def main():
+    rng = np.random.default_rng(0)
+    chunk = 131072
+    L = 12
+    n = chunk * L
+    R = 2 * chunk
+    cap = 1 << 26
+    nb = cap // 8
+    print(f"dev={jax.devices()[0]} chunk={chunk} ncand={n} R={R}", flush=True)
+
+    lo = jnp.asarray(rng.integers(0, 1 << 32, n, dtype=np.uint32))
+    hi = jnp.asarray(rng.integers(0, 1 << 32, n, dtype=np.uint32))
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    flag = jnp.asarray(rng.integers(0, 4, n, dtype=np.uint32) == 0)  # ~25% valid
+
+    # sort1 as committed: 4 arrays, 3 keys, stable
+    def s4k3(c):
+        a, b, d, e = lax.sort(((~flag).astype(jnp.uint32), hi, lo ^ c, idx),
+                              num_keys=3, is_stable=True)
+        return c + a[0]
+
+    fused_time(f"sort1 now: {n} 4-arr 3-key stable", s4k3, jnp.uint32(1))
+
+    # sort1 alt: invalid encoded as fp (0,0) -> 3 arrays, 2 keys
+    lo0 = jnp.where(flag, lo, 0)
+    hi0 = jnp.where(flag, hi, 0)
+
+    def s3k2(c):
+        a, b, d = lax.sort((hi0, lo0 ^ (c & jnp.uint32(0)) ^ lo0 * 0 + (lo0 ^ c * 0), idx),
+                           num_keys=2, is_stable=True)
+        return c + a[0]
+
+    def s3k2b(c):
+        a, b, d = lax.sort((hi0 ^ (c * 0), lo0, idx), num_keys=2,
+                           is_stable=True)
+        return c + a[0]
+
+    fused_time(f"sort1 alt: {n} 3-arr 2-key stable", s3k2b, jnp.uint32(1))
+
+    # sort2 as committed: 4 arrays, 1 key, stable
+    def s4k1(c):
+        a, b, d, e = lax.sort((flag.astype(jnp.uint32) ^ (c * 0), lo, hi, idx),
+                              num_keys=1, is_stable=True)
+        return c + b[0]
+
+    fused_time(f"sort2 now: {n} 4-arr 1-key stable", s4k1, jnp.uint32(1))
+
+    # enqueue sort as committed: full-n 2-arr 2-key
+    def enq_full(c):
+        a, b = lax.sort((flag.astype(jnp.uint32) ^ (c * 0), idx), num_keys=2,
+                        is_stable=True)
+        return c + b[0]
+
+    fused_time(f"enq sort now: {n} 2-arr 2-key", enq_full, jnp.uint32(1))
+
+    def enq_R(c):
+        a, b = lax.sort((flag[:R].astype(jnp.uint32) ^ (c * 0), idx[:R]),
+                        num_keys=2, is_stable=True)
+        return c + b[0]
+
+    fused_time(f"enq sort alt: {R} 2-arr 2-key", enq_R, jnp.uint32(1))
+
+    # probe gather at R of [nb,16]
+    t16 = jnp.zeros((nb, 16), jnp.uint32)
+    bid = jnp.asarray(rng.integers(0, nb, R, dtype=np.int32))
+
+    def g16(c):
+        t, x = c
+        r = t[(bid + x) & (nb - 1)]
+        return (t, x + r[0, 0].astype(jnp.int32) + 1)
+
+    fused_time(f"probe gather {R} rows [16]u32", g16, (t16, jnp.int32(0)))
+
+    # claim scatter now: 2x element scatter width R
+    cb = jnp.asarray(rng.integers(0, nb, R, dtype=np.int32))
+    cs = jnp.asarray(rng.integers(0, 8, R, dtype=np.int32))
+    vlo = jnp.asarray(rng.integers(0, 1 << 32, R, dtype=np.uint32))
+    vhi = jnp.asarray(rng.integers(0, 1 << 32, R, dtype=np.uint32))
+
+    def sc2e(c):
+        t, x = c
+        b = (cb + x) & (nb - 1)
+        t = t.at[b, 2 * cs].set(vlo, mode="drop")
+        t = t.at[b, 2 * cs + 1].set(vhi, mode="drop")
+        return (t, x + 1)
+
+    fused_time(f"claim now: 2x elem scatter {R}", sc2e, (t16, jnp.int32(0)))
+
+    C2 = chunk
+
+    def sc2e_h(c):
+        t, x = c
+        b = (cb[:C2] + x) & (nb - 1)
+        t = t.at[b, 2 * cs[:C2]].set(vlo[:C2], mode="drop")
+        t = t.at[b, 2 * cs[:C2] + 1].set(vhi[:C2], mode="drop")
+        return (t, x + 1)
+
+    fused_time(f"claim alt: 2x elem scatter {C2}", sc2e_h, (t16, jnp.int32(0)))
+
+    # stats now: scatter-add A into chunk+1 bins + A into 31 bins
+    A = R
+    srcrow = jnp.asarray(rng.integers(0, chunk, A, dtype=np.int32))
+    acts = jnp.asarray(rng.integers(0, 30, A, dtype=np.int32))
+    deg = jnp.zeros(chunk + 1, jnp.uint32)
+    cnt = jnp.zeros(31, jnp.uint32)
+
+    def deg_sc(c):
+        t, x = c
+        t = t.at[jnp.minimum(srcrow + (x & 1), chunk)].add(1)
+        return (t, x + 1)
+
+    fused_time(f"deg scatter-add {A} into {chunk+1} bins", deg_sc,
+               (deg, jnp.int32(0)))
+
+    def act_sc(c):
+        t, x = c
+        t = t.at[jnp.minimum(acts + (x & 1), 30)].add(1)
+        return (t, x + 1)
+
+    fused_time(f"act scatter-add {A} into 31 bins", act_sc,
+               (cnt, jnp.int32(0)))
+
+    def act_cr(c):
+        t, x = c
+        oh = (acts[:, None] == (jnp.arange(31)[None, :] - (x & 1)))
+        return (t + oh.sum(0).astype(jnp.uint32), x + 1)
+
+    fused_time(f"act compare-reduce {A} into 31 bins", act_cr,
+               (cnt, jnp.int32(0)))
+
+    # deg alt: sorted-run lengths -> [L+2] hist (srcrow sorted ascending)
+    ssrc = jnp.sort(srcrow)
+
+    def deg_runs(c):
+        t, x = c
+        s = ssrc + (x & 1)
+        startf = jnp.concatenate([jnp.ones(1, bool), s[1:] != s[:-1]])
+        pos = jnp.arange(A, dtype=jnp.int32)
+        run0 = lax.cummax(jnp.where(startf, pos, 0))
+        endf = jnp.concatenate([s[1:] != s[:-1], jnp.ones(1, bool)])
+        ln = jnp.where(endf, pos - run0 + 1, 0)
+        lnc = jnp.minimum(ln, L + 1)
+        oh = (lnc[:, None] == (jnp.arange(1, L + 2)[None, :]))
+        hist = oh.sum(0).astype(jnp.uint32)
+        return (t.at[: L + 1].add(hist), x + 1)
+
+    fused_time(f"deg run-length {A} -> [L+2] hist", deg_runs,
+               (jnp.zeros(L + 2, jnp.uint32), jnp.int32(0)))
+
+    # enqueue row gather A of [n,7] + contiguous write
+    packed = jnp.asarray(rng.integers(0, 1 << 32, (n, 7), dtype=np.uint32))
+    q = jnp.zeros((1 << 21, 7), jnp.uint32)
+    gidx = jnp.asarray(rng.integers(0, n, A, dtype=np.int32))
+
+    def enq_g(c):
+        q_, x = c
+        rows = packed[(gidx + x) % n]
+        q_ = lax.dynamic_update_slice(q_, rows, (jnp.int32(0), jnp.int32(0)))
+        return (q_, x + 1)
+
+    fused_time(f"enq gather {A} rows [7]u32 + contig write", enq_g,
+               (q, jnp.int32(0)))
+
+
+if __name__ == "__main__":
+    main()
