@@ -61,25 +61,22 @@ def _run_check(args) -> int:
     if args.recover and not args.checkpoint:
         print("Error: -recover requires -checkpoint PATH", file=sys.stderr)
         return 1
-    if args.fpset == "DiskFPSet" and (args.checkpoint or args.sharded):
-        print(
-            "Error: -fpset DiskFPSet is not supported with -checkpoint "
-            "or -sharded yet",
-            file=sys.stderr,
-        )
-        return 1
 
-    log = TLCLog(tool_mode=not args.noTool)
+    log = TLCLog(tool_mode=not args.noTool,
+                 **_render_sources(args.config, spec.spec_name))
     import jax
 
     device = str(jax.devices()[0])
     log.version(__version__)
     log.banner(spec.fp_index, DEFAULT_SEED, spec.workers, device)
+    log.sany(*_sany_inputs(args.config, spec.spec_name))
     log.starting()
     log.computing_init()
 
     t0 = time.time()
-    if args.sharded:
+    # dispatch priority: DiskFPSet routes to the host tier even when
+    # -sharded is given (sharding then means fingerprint-space partitions)
+    if args.sharded and args.fpset != "DiskFPSet":
         import numpy as np
         from jax.sharding import Mesh
 
@@ -112,11 +109,28 @@ def _run_check(args) -> int:
             )
     elif args.fpset == "DiskFPSet":
         # the OffHeapDiskFPSet/DiskStateQueue analog: authoritative dedup +
-        # frontier in the native (C++, disk-bounded) host tier
+        # frontier in the native (C++, disk-bounded) host tier.  Composes
+        # with -checkpoint (the disk tier's files ARE the snapshot, as in
+        # TLC) and with -sharded N (N fingerprint-space partitions - the
+        # distributed-fingerprint-server analog, launch:4)
         from .engine.hybrid import check_hybrid
 
+        nparts = max(args.sharded, 1)
+        if nparts & (nparts - 1):
+            print(
+                "Error: -sharded with -fpset DiskFPSet needs a power-of-"
+                f"two partition count, got {nparts}",
+                file=sys.stderr,
+            )
+            return 1
         r = check_hybrid(
-            spec.model, chunk=args.chunk, fp_index=spec.fp_index
+            spec.model,
+            chunk=args.chunk,
+            fp_index=spec.fp_index,
+            fp_partitions=nparts,
+            ckpt_path=args.checkpoint or None,
+            ckpt_every=args.checkpointevery,
+            resume=args.recover,
         )
     elif args.checkpoint:
         from .engine.checkpoint import check_with_checkpoints
@@ -223,6 +237,52 @@ def _run_check(args) -> int:
     return 13 if liveness_violated else 0  # TLC liveness exit convention
 
 
+def _render_sources(cfg_path: str, spec_name: str) -> dict:
+    """Rendering inputs derived from the model directory (M4): the
+    action-line table scanned from the spec's committed translation, and
+    the Toolbox .pmap (generated-TLA -> PlusCal source map) when present."""
+    import os
+
+    out = {}
+    model_dir = os.path.dirname(os.path.abspath(cfg_path))
+    tla = os.path.join(model_dir, f"{spec_name}.tla")
+    if os.path.exists(tla):
+        from .io.tlc_log import action_lines_from_spec
+
+        out["action_lines"] = action_lines_from_spec(tla)
+    pmap_path = os.path.join(
+        os.path.dirname(model_dir), f"{spec_name}.tla.pmap"
+    )
+    if os.path.exists(pmap_path):
+        from .frontend.pmap import PmapError, parse_pmap_file
+
+        try:
+            out["pcal_map"] = parse_pmap_file(pmap_path)
+        except PmapError:
+            pass  # a corrupt pmap must not break the run (Toolbox parity)
+    return out
+
+
+def _sany_inputs(cfg_path: str, spec_name: str):
+    """Files actually read + modules resolved, for the SANY log section."""
+    import os
+
+    model_dir = os.path.dirname(os.path.abspath(cfg_path))
+    files, modules = [], []
+    # TLC's order (MC.out:8-24): the root MC.tla parses first, semantic
+    # processing finishes with the root module last
+    mc = os.path.join(model_dir, "MC.tla")
+    if os.path.exists(mc):
+        files.append(mc)
+    sp = os.path.join(model_dir, f"{spec_name}.tla")
+    if os.path.exists(sp):
+        files.append(sp)
+        modules.append(spec_name)
+    if os.path.exists(mc):
+        modules.append("MC")
+    return files, modules
+
+
 def _run_check_gen(args, spec) -> int:
     """Check a generic-frontend spec (E1): device engine + host liveness.
 
@@ -257,6 +317,7 @@ def _run_check_gen(args, spec) -> int:
     device = str(jax.devices()[0])
     log.version(__version__)
     log.banner(spec.fp_index, DEFAULT_SEED, spec.workers, device)
+    log.sany(*_sany_inputs(args.config, spec.spec_name))
     log.starting()
     log.computing_init()
     t0 = time.time()

@@ -52,12 +52,52 @@ ACTION_LINES: Dict[str, int] = {
 }
 
 
+def action_lines_from_spec(tla_path: str) -> Dict[str, int]:
+    """Derive the label -> translation-line table by scanning the spec's
+    committed PlusCal translation, so the rendering table tracks the
+    actual module instead of a hand-maintained copy (M4).
+
+    A translated ACTION is recognizable without any prior label list: it
+    is a definition whose body opens with its own pc guard
+    (``Name(self) == /\\ pc[self] = "Name"``) - the shape every PlusCal
+    label translates to - plus ``Init``.  New or renamed labels are
+    picked up automatically; ACTION_LINES remains the fallback for
+    actions the file doesn't define.
+
+    Property-tested against the reference: the derived table equals the
+    committed ACTION_LINES for KubeAPI.tla (tests/test_pmap.py)."""
+    table: Dict[str, int] = {}
+    label_re = re.compile(
+        r"^([A-Za-z_][A-Za-z0-9_]*)(?:\(self\))?\s*==\s*"
+        r"(?:/\\\s*)?pc\[self\]\s*=\s*\"([A-Za-z0-9_]+)\""
+    )
+    init_re = re.compile(r"^Init\s*==")
+    with open(tla_path, "r", encoding="utf-8") as f:
+        for i, ln in enumerate(f, start=1):
+            if init_re.match(ln):
+                table.setdefault("Init", i)
+                continue
+            m = label_re.match(ln)
+            if m and m.group(1) == m.group(2):
+                table.setdefault(m.group(1), i)
+    return {**ACTION_LINES, **table}
+
+
 class TLCLog:
-    def __init__(self, out: Optional[TextIO] = None, tool_mode: bool = True):
+    def __init__(self, out: Optional[TextIO] = None, tool_mode: bool = True,
+                 action_lines: Optional[Dict[str, int]] = None,
+                 pcal_map=None):
         # resolve sys.stdout at call time (a def-time default would pin the
         # stream before test harnesses / redirections can swap it)
         self.out = sys.stdout if out is None else out
         self.tool = tool_mode
+        self.action_lines = (
+            ACTION_LINES if action_lines is None else action_lines
+        )
+        # optional frontend.pmap.TLAtoPCalMapping: trace headers then name
+        # the PlusCal source location (the Toolbox jump target) alongside
+        # the generated-TLA line
+        self.pcal_map = pcal_map
 
     def raw(self, line: str) -> None:
         """Emit a pre-framed line verbatim (the coverage renderer frames
@@ -85,6 +125,16 @@ class TLCLog:
             f"and seed {seed} with {workers} workers on {device} "
             "(JaxFPSet, DeviceStateQueue).",
         )
+
+    def sany(self, files, modules) -> None:
+        """The SANY parse phase (MC.out:7-24): codes 2220/2219 framing the
+        files this run actually read and the modules it resolved."""
+        self.msg(2220, "Starting SANY...")
+        for f in files:
+            self.raw(f"Parsing file {f}")
+        for m in modules:
+            self.raw(f"Semantic processing of module {m}")
+        self.msg(2219, "SANY finished.")
 
     def starting(self) -> None:
         self.msg(2185, f"Starting... ({time.strftime('%Y-%m-%d %H:%M:%S')})")
@@ -139,10 +189,10 @@ class TLCLog:
             2201,
             f"The coverage statistics at {time.strftime('%Y-%m-%d %H:%M:%S')}",
         )
-        self.msg(2773, f"<Init line {ACTION_LINES['Init']}, col 1 to line "
-                       f"{ACTION_LINES['Init']}, col 4 of module KubeAPI>: "
+        self.msg(2773, f"<Init line {self.action_lines['Init']}, col 1 to line "
+                       f"{self.action_lines['Init']}, col 4 of module KubeAPI>: "
                        f"{init_count}:{init_count}")
-        for name, line in ACTION_LINES.items():
+        for name, line in self.action_lines.items():
             if name == "Init":
                 continue
             g = act_gen.get(name, 0)
@@ -215,9 +265,16 @@ class TLCLog:
         if action is None:
             head = f"State {index}: <Initial predicate>"
         else:
-            line = ACTION_LINES.get(action, 0)
+            line = self.action_lines.get(action, 0)
             head = (
                 f"State {index}: <{action} line {line}, col 1 to line {line}, "
                 f"col {len(action)} of module KubeAPI>"
             )
+            if self.pcal_map is not None and not self.tool:
+                # PlusCal-level rendering (M4): the .pmap maps the
+                # generated-TLA action line back to the algorithm source -
+                # the Toolbox's jump target, shown inline in plain mode
+                loc = self.pcal_map.pcal_location(line)
+                if loc is not None:
+                    head += f"  [PlusCal line {loc[0]}, col {loc[1] + 1}]"
         self.msg(2217, head + "\n" + text, severity=1)

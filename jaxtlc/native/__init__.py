@@ -78,6 +78,17 @@ def lib() -> ctypes.CDLL:
         _lib.sq_len.argtypes = [ctypes.c_void_p]
         _lib.sq_tail.restype = ctypes.c_uint64
         _lib.sq_tail.argtypes = [ctypes.c_void_p]
+        _lib.sq_open_at.restype = ctypes.c_void_p
+        _lib.sq_open_at.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+        ]
+        _lib.sq_sync.restype = ctypes.c_int
+        _lib.sq_sync.argtypes = [ctypes.c_void_p]
+        _lib.sq_head.restype = ctypes.c_uint64
+        _lib.sq_head.argtypes = [ctypes.c_void_p]
         _lib.sq_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
     return _lib
 
@@ -156,7 +167,8 @@ class HostStateQueue:
     on close only when the library created it (no `path` given) - a
     caller-supplied path is left in place."""
 
-    def __init__(self, record_fields: int, path: str = None):
+    def __init__(self, record_fields: int, path: str = None,
+                 resume_head: int = None, resume_tail: int = None):
         self._own_tmp = path is None
         if path is None:
             fd, path = tempfile.mkstemp(suffix=".sq")
@@ -164,7 +176,13 @@ class HostStateQueue:
         self.path = path
         self.record_fields = record_fields
         self._rb = record_fields * 4
-        self._h = lib().sq_open(path.encode(), self._rb)
+        if resume_head is not None:
+            # reopen without truncation at checkpointed cursors
+            self._h = lib().sq_open_at(
+                path.encode(), self._rb, resume_head, resume_tail
+            )
+        else:
+            self._h = lib().sq_open(path.encode(), self._rb)
         if not self._h:
             raise OSError(f"sq_open failed for {path!r}")
 
@@ -188,6 +206,14 @@ class HostStateQueue:
     @property
     def total_pushed(self) -> int:
         return int(lib().sq_tail(self._h))
+
+    @property
+    def head(self) -> int:
+        return int(lib().sq_head(self._h))
+
+    def sync(self) -> None:
+        if lib().sq_sync(self._h) != 0:
+            raise OSError("sq_sync failed")
 
     def close(self) -> None:
         if self._h:

@@ -42,6 +42,32 @@ void *sq_open(const char *path, uint64_t record_bytes) {
   return q;
 }
 
+// reopen an existing queue file WITHOUT truncation, restoring the
+// head/tail cursors a checkpoint recorded (the -recover path)
+void *sq_open_at(const char *path, uint64_t record_bytes, uint64_t head,
+                 uint64_t tail) {
+  Queue *q = new Queue();
+  q->path = path;
+  q->record_bytes = record_bytes;
+  q->f = fopen(path, "r+b");
+  if (!q->f) {
+    delete q;
+    return nullptr;
+  }
+  setvbuf(q->f, nullptr, _IOFBF, 1 << 20);
+  q->head = head;
+  q->tail = tail;
+  return q;
+}
+
+// flush buffered writes to the file (checkpoint barrier)
+int sq_sync(void *handle) {
+  Queue *q = static_cast<Queue *>(handle);
+  return fflush(q->f) ? -1 : 0;
+}
+
+uint64_t sq_head(void *handle) { return static_cast<Queue *>(handle)->head; }
+
 int sq_push(void *handle, const void *records, int64_t n) {
   Queue *q = static_cast<Queue *>(handle);
   if (fseeko(q->f, static_cast<off_t>(q->tail * q->record_bytes), SEEK_SET))

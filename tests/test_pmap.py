@@ -1,0 +1,117 @@
+"""Toolbox .pmap consumption tests (M4): the Java-serialized
+TLAtoPCalMapping must parse, its structure must match the committed
+translation region, its locations must land on the real PlusCal source,
+and the derived action-line table must agree with the committed one."""
+
+import os
+
+import pytest
+
+REF = "/root/reference/KubeAPI.toolbox"
+PMAP = os.path.join(REF, "KubeAPI.tla.pmap")
+TLA = os.path.join(REF, "Model_1", "KubeAPI.tla")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(PMAP), reason="reference toolbox not mounted"
+)
+
+
+@pytest.fixture(scope="module")
+def pmap():
+    from jaxtlc.frontend.pmap import parse_pmap_file
+
+    return parse_pmap_file(PMAP)
+
+
+def test_structure_matches_translation_region(pmap):
+    # BEGIN TRANSLATION sits at KubeAPI.tla:373; the algorithm block opens
+    # at line 11 (0-based 10)
+    assert pmap.tla_start_line == 373
+    assert pmap.alg_line == 10
+    assert pmap.n_lines == 394  # translation region line count
+
+
+def test_known_action_locations(pmap):
+    # CStart's guard (TLA line 528) maps to the `either` statement that
+    # follows the CStart: label (KubeAPI.tla:167, col 4)
+    assert pmap.pcal_location(528) == (167, 4)
+    with open(TLA) as f:
+        lines = f.readlines()
+    assert lines[166].strip().startswith("either")
+    # every committed action line maps INTO the PlusCal algorithm block
+    # (after --algorithm, before BEGIN TRANSLATION)
+    from jaxtlc.io.tlc_log import ACTION_LINES
+
+    for name, line in ACTION_LINES.items():
+        loc = pmap.pcal_location(line)
+        assert loc is not None, name
+        assert pmap.alg_line < loc[0] < pmap.tla_start_line, (name, loc)
+
+
+def test_out_of_region_lines(pmap):
+    assert pmap.pcal_location(1) is None
+    assert pmap.pcal_location(10_000) is None
+
+
+def test_derived_action_lines_match_committed():
+    from jaxtlc.io.tlc_log import ACTION_LINES, action_lines_from_spec
+
+    derived = action_lines_from_spec(TLA)
+    assert derived == ACTION_LINES
+
+
+def test_trace_header_carries_pcal_location(pmap, capsys):
+    from jaxtlc.io.tlc_log import TLCLog
+
+    log = TLCLog(tool_mode=False, pcal_map=pmap)
+    log.trace_state(3, "CStart", "/\\ x = 1")
+    out = capsys.readouterr().out
+    assert "State 3: <CStart line 528" in out
+    assert "[PlusCal line 167, col 5]" in out
+
+
+def test_corrupt_pmap_is_pmap_error(tmp_path):
+    from jaxtlc.frontend.pmap import PmapError, parse_pmap_bytes
+
+    with open(PMAP, "rb") as f:
+        data = f.read()
+    for corrupt in (
+        data[:50],                                  # truncated
+        data[:40] + b"\xff\xfe" + data[42:],        # bad utf-8 payload
+        b"\x00\x01" + data[2:],                     # wrong magic
+        b"",
+    ):
+        with pytest.raises(PmapError):
+            parse_pmap_bytes(corrupt)
+
+
+def test_derived_table_picks_up_new_labels(tmp_path):
+    # a label the hardcoded table has never heard of must be derived
+    from jaxtlc.io.tlc_log import action_lines_from_spec
+
+    p = tmp_path / "Spec.tla"
+    p.write_text(
+        "---- MODULE Spec ----\n"
+        "Init == x = 0\n"
+        'CRetry(self) == /\\ pc[self] = "CRetry"\n'
+        '                /\\ x\' = x\n'
+        "====\n"
+    )
+    table = action_lines_from_spec(str(p))
+    assert table["CRetry"] == 3
+    assert table["Init"] == 2
+
+
+def test_cli_reference_run_uses_pmap(capsys):
+    """End-to-end: a violation run against the REFERENCE model directory
+    renders traces with PlusCal locations from the real .pmap."""
+    from jaxtlc.cli import main
+
+    rc = main([
+        "check", os.path.join(REF, "Model_1", "MC.cfg"), "-noTool",
+        "-mutation", "delete_noop", "-chunk", "128", "-qcap", "4096",
+        "-fpcap", "16384",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 12
+    assert "[PlusCal line" in out

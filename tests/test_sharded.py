@@ -60,6 +60,23 @@ def test_sharded_checkpoint_resume(tmp_path):
     assert resumed.queue_left == 0 and resumed.violation == 0
 
 
+@pytest.mark.slow
+def test_sharded_model1_tt_exact():
+    """Full Model_1 (both fault constants TRUE) on the 8-device mesh must
+    reproduce TLC's exact committed counts (MC.out:1098,1101) - the real
+    workload, not just the FF corner (VERDICT r3 item 4).  ~70s on this
+    box's single CPU core."""
+    r = check_sharded(
+        ModelConfig(True, True), _mesh(8),
+        chunk=2048, queue_capacity=1 << 15, fp_capacity=1 << 19,
+    )
+    assert (r.generated, r.distinct, r.depth) == (577736, 163408, 124)
+    assert r.queue_left == 0 and r.violation == 0
+    # per-action generated parity with MC.out:78,621 spot values
+    assert r.action_generated["DoRequest"] == 149766
+    assert r.action_generated["APIStart"] == 27059
+
+
 def test_graft_entry_dryrun():
     import __graft_entry__ as g
 
