@@ -144,6 +144,7 @@ def _run_check(args) -> int:
             ckpt_path=args.checkpoint,
             ckpt_every=args.checkpointevery,
             resume=args.recover,
+            on_progress=log.progress,
         )
     else:
         from .engine.bfs import check

@@ -105,6 +105,7 @@ def check_with_checkpoints(
     ckpt_every: int = 256,
     resume: bool = False,
     max_segments: Optional[int] = None,
+    on_progress=None,
 ) -> CheckResult:
     """Exhaustive check with periodic checkpoints every `ckpt_every` chunks.
 
@@ -112,7 +113,11 @@ def check_with_checkpoints(
     geometry + config) and continues; the final counts equal an
     uninterrupted run's.  max_segments stops early (for tests / simulated
     interruption) after that many fused segments, leaving a valid checkpoint
-    behind.
+    behind.  on_progress(depth, generated, distinct, queue_left) fires at
+    every segment boundary - the TLC mid-run Progress-line analog
+    (MC.out:35: TLC prints Progress(level) periodically; the fused
+    single-dispatch engine has no sync point to report from, this driver
+    does).
     """
     init_fn, _, step_fn = make_engine(
         cfg, chunk, queue_capacity, fp_capacity, fp_index, seed
@@ -161,6 +166,13 @@ def check_with_checkpoints(
         segments += 1
         if ckpt_path is not None:
             save_checkpoint(ckpt_path, carry, meta)
+        if on_progress is not None and not carry_done(carry):
+            on_progress(
+                int(carry.depth),
+                int(carry.generated),
+                int(carry.distinct),
+                int(carry.level_n) - int(carry.qhead) + int(carry.next_n),
+            )
 
     wall = time.time() - t0
     from .fpset import fpset_actual_collision

@@ -18,10 +18,12 @@ minimizes them.
   (mixed) fingerprint is the top bits of ``hi`` - monotonic in
   fingerprint sort order.
 * **Sort-compact, then probe only unique candidates**: one stable sort
-  groups duplicate fingerprints (invalid lanes segregate on a separate
-  leading key - NOT a sentinel value, which a real fingerprint could
-  equal); a second stable 1-key sort compacts the group representatives to
-  the front, so the probe phase touches O(unique) rows, not O(batch).
+  groups duplicate fingerprints; invalid lanes encode as the RESERVED
+  (0,0) word pair (safe because ``_remap`` maps any real (0,0)
+  fingerprint to (1,0) first), so validity costs no extra sort key -
+  3 arrays / 2 keys per comparator pass.  A second stable 1-key sort
+  compacts the group representatives to the front, so the probe phase
+  touches O(unique) rows, not O(batch).
 * **Conflict-free claims**: because compacted candidates arrive sorted,
   same-bucket claimants are adjacent runs; each claimant takes slot
   ``occupancy + rank-in-run``, so round-0 insertions cannot collide - no
@@ -370,22 +372,23 @@ def fpset_insert_sorted(
     lo, hi = _mix(lo, hi)
     lo, hi = _remap(lo, hi)
 
-    # sort 1: group duplicates; validity is the leading key (NOT a
-    # sentinel fingerprint value, which a real fingerprint could equal)
-    inval = (~mask).astype(jnp.uint32)
+    # sort 1: group duplicates.  Invalid lanes are encoded as the RESERVED
+    # (0,0) word pair - _remap guarantees no real fingerprint is (0,0) -
+    # so validity needs no separate sort key: 3 arrays / 2 keys instead of
+    # 4 / 3 (each key array is a full comparator-network pass on TPU).
+    # Invalids therefore sort FIRST; reps are the last element of each
+    # nonzero group.
+    lo = jnp.where(mask, lo, 0)
+    hi = jnp.where(mask, hi, 0)
     idx = jnp.arange(n, dtype=jnp.uint32)
-    s_inv, s_hi, s_lo, s_idx = lax.sort(
-        (inval, hi, lo, idx), num_keys=3, is_stable=True
-    )
+    s_hi, s_lo, s_idx = lax.sort((hi, lo, idx), num_keys=2, is_stable=True)
     last = jnp.concatenate(
         [
-            (s_inv[1:] != s_inv[:-1])
-            | (s_hi[1:] != s_hi[:-1])
-            | (s_lo[1:] != s_lo[:-1]),
+            (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1]),
             jnp.ones(1, bool),
         ]
     )
-    rep = (s_inv == 0) & last
+    rep = ((s_hi != 0) | (s_lo != 0)) & last
 
     # sort 2: compact representatives to the front (stable single-key sort
     # keeps them fingerprint-sorted - required by _probe_block's rank math)

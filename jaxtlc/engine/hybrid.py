@@ -167,7 +167,9 @@ def check_hybrid(
         for p in range(D):
             dst = fp_path if D == 1 else f"{fp_path}.{p}"
             shutil.copyfile(f"{ckpt_path}.g{gen}.fps{p}", dst)
-        shutil.copyfile(f"{ckpt_path}.g{gen}.sq", queue_path)
+        # the queue snapshot is a single incremental mirror (append-only
+        # up to the recorded tail; see checkpoint())
+        shutil.copyfile(f"{ckpt_path}.sq.snap", queue_path)
 
     tier = _open_tier(F, D, fp_path, queue_path, initial_fp_capacity,
                       resume_meta)
@@ -226,19 +228,34 @@ def check_hybrid(
             0 if resume_meta is None
             else int(resume_meta.get("generation", 0))
         )
+        # queue-mirror high-water mark: the mirror is valid in
+        # [0, snap_tail) records; a fresh run starts a fresh mirror
+        snap_tail = (
+            0 if resume_meta is None else int(resume_meta["q_tail"])
+        )
+        if ckpt_path and resume_meta is None:
+            _rm(f"{ckpt_path}.sq.snap")
 
         def checkpoint():
-            # generation-numbered snapshot files + meta replaced LAST: the
-            # snapshot SET is consistent under a crash at any point (the
-            # old meta keeps naming the old, complete generation)
-            nonlocal gen_counter
+            # generation-numbered fp snapshots + an incremental queue
+            # mirror + meta replaced LAST, all fsynced: the snapshot SET
+            # is consistent under a crash at any point (the old meta keeps
+            # naming the old, complete generation; a torn mirror append
+            # only touches bytes beyond the old meta's recorded tail).
+            # The queue file is append-only in [0, tail), so the mirror
+            # copies just the delta - checkpoint I/O stays O(new states),
+            # not O(total pushed) per checkpoint.
+            nonlocal gen_counter, snap_tail
             gen = gen_counter + 1
             for s in stores:
                 s.sync()
             queue.sync()
             for p, s in enumerate(stores):
-                shutil.copyfile(s.path, f"{ckpt_path}.g{gen}.fps{p}")
-            shutil.copyfile(queue.path, f"{ckpt_path}.g{gen}.sq")
+                _copy_fsync(s.path, f"{ckpt_path}.g{gen}.fps{p}")
+            rb = F * 4
+            tail = queue.total_pushed
+            _append_region(queue.path, f"{ckpt_path}.sq.snap",
+                           snap_tail * rb, tail * rb)
             meta = dict(
                 format="jaxtlc-hybrid-ckpt-v1",
                 config=repr(cfg),
@@ -258,13 +275,16 @@ def check_hybrid(
             tmp = ckpt_path + ".meta.json.tmp"
             with open(tmp, "w") as f:
                 json.dump(meta, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, ckpt_path + ".meta.json")
+            _fsync_dir(os.path.dirname(os.path.abspath(ckpt_path)))
             gen_counter = gen
-            # best-effort cleanup of superseded generations
+            snap_tail = tail
+            # best-effort cleanup of superseded fp generations
             for g in range(max(gen - 2, 0), gen):
                 for p in range(D):
                     _rm(f"{ckpt_path}.g{g}.fps{p}")
-                _rm(f"{ckpt_path}.g{g}.sq")
 
         while len(queue) and viol == OK:
             if max_chunks is not None and chunks_done >= max_chunks:
@@ -368,6 +388,49 @@ def check_hybrid(
 def _rm(path: str) -> None:
     try:
         os.unlink(path)
+    except OSError:
+        pass
+
+
+def _copy_fsync(src: str, dst: str) -> None:
+    """Copy + fsync: the snapshot must be ON DISK before the meta that
+    names it is replaced (page-cache-only copies can reach disk after
+    the rename under a crash)."""
+    shutil.copyfile(src, dst)
+    with open(dst, "rb+") as f:
+        os.fsync(f.fileno())
+
+
+def _append_region(src: str, dst: str, start: int, end: int) -> None:
+    """Write src's byte range [start, end) into dst at the same offset,
+    fsynced (the incremental queue-mirror append)."""
+    if end <= start:
+        with open(dst, "ab"):
+            pass
+        return
+    with open(src, "rb") as fsrc, open(
+        dst, "r+b" if os.path.exists(dst) else "w+b"
+    ) as fdst:
+        fsrc.seek(start)
+        fdst.seek(start)
+        remaining = end - start
+        while remaining:
+            buf = fsrc.read(min(remaining, 1 << 22))
+            if not buf:
+                raise OSError("queue file shorter than its tail cursor")
+            fdst.write(buf)
+            remaining -= len(buf)
+        fdst.flush()
+        os.fsync(fdst.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
     except OSError:
         pass
 
