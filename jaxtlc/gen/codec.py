@@ -38,8 +38,8 @@ class GenCodec:
             for v in decl.domain.values:
                 if isinstance(v, str) and v not in strings:
                     strings.append(v)
-            if decl.index_set:
-                for s in decl.index_set:
+            for iset in (decl.index_set, decl.index_set2):
+                for s in iset or ():
                     if s not in strings:
                         strings.append(s)
         for c in spec.constants.values():
@@ -52,19 +52,26 @@ class GenCodec:
         self.strings = sorted(strings)
         self.sid = {s: i for i, s in enumerate(self.strings)}
 
-        # components: flat field layout
-        self.components: List[Tuple[str, Optional[str]]] = []
+        # components: flat field layout (two-level functions flatten
+        # row-major: (i, j) for i in index_set for j in index_set2)
+        self.components: List[Tuple[str, object]] = []
         self.offsets: Dict[str, int] = {}
         self.widths: List[int] = []
         for decl in spec.variables:
             self.offsets[decl.name] = len(self.components)
+            w = _bits_for(decl.domain.size)
             if decl.index_set is None:
                 self.components.append((decl.name, None))
-                self.widths.append(_bits_for(decl.domain.size))
-            else:
+                self.widths.append(w)
+            elif decl.index_set2 is None:
                 for idx in decl.index_set:
                     self.components.append((decl.name, idx))
-                    self.widths.append(_bits_for(decl.domain.size))
+                    self.widths.append(w)
+            else:
+                for i in decl.index_set:
+                    for j in decl.index_set2:
+                        self.components.append((decl.name, (i, j)))
+                        self.widths.append(w)
         self.n_fields = len(self.components)
         self.nbits = sum(self.widths)
         self.n_words = (self.nbits + 31) // 32
@@ -90,13 +97,17 @@ class GenCodec:
             return self.sid[v]
         raise ValueError(f"no abstract value for {v!r}")
 
-    def comp_index(self, var: str, idx: Optional[str]) -> int:
+    def comp_index(self, var: str, idx, idx2=None) -> int:
         decl = self.spec.var(var)
         off = self.offsets[var]
         if decl.index_set is None:
             assert idx is None
             return off
-        return off + decl.index_set.index(idx)
+        i = decl.index_set.index(idx)
+        if decl.index_set2 is None:
+            assert idx2 is None
+            return off + i
+        return off + i * len(decl.index_set2) + decl.index_set2.index(idx2)
 
     def encode(self, st) -> np.ndarray:
         """Oracle state (tuple of values / pair-tuples) -> [F] int32."""
@@ -105,10 +116,17 @@ class GenCodec:
             off = self.offsets[decl.name]
             if decl.index_set is None:
                 out[off] = decl.domain.code(val)
-            else:
+            elif decl.index_set2 is None:
                 d = dict(val)
                 for j, idx in enumerate(decl.index_set):
                     out[off + j] = decl.domain.code(d[idx])
+            else:
+                d = dict(val)
+                n2 = len(decl.index_set2)
+                for i, idx in enumerate(decl.index_set):
+                    row = dict(d[idx])
+                    for j, idx2 in enumerate(decl.index_set2):
+                        out[off + i * n2 + j] = decl.domain.code(row[idx2])
         return out
 
     def decode(self, vec) -> tuple:
@@ -118,10 +136,19 @@ class GenCodec:
             off = self.offsets[decl.name]
             if decl.index_set is None:
                 vals.append(decl.domain.values[int(v[off])])
-            else:
+            elif decl.index_set2 is None:
                 vals.append(tuple(
                     (idx, decl.domain.values[int(v[off + j])])
                     for j, idx in enumerate(decl.index_set)
+                ))
+            else:
+                n2 = len(decl.index_set2)
+                vals.append(tuple(
+                    (idx, tuple(
+                        (idx2, decl.domain.values[int(v[off + i * n2 + j])])
+                        for j, idx2 in enumerate(decl.index_set2)
+                    ))
+                    for i, idx in enumerate(decl.index_set)
                 ))
         return texpr.canon(tuple(vals))
 

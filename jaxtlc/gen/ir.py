@@ -44,33 +44,52 @@ class Domain:
 
 @dataclasses.dataclass(frozen=True)
 class VarDecl:
-    """One VARIABLE: scalar (index_set None) or function over index_set."""
+    """One VARIABLE: scalar (index_set None), a one-level function over
+    index_set, or a two-level function [index_set -> [index_set2 -> D]]
+    (e.g. Raft's per-pair voteGranted matrix)."""
 
     name: str
     domain: Domain
     index_set: Optional[Tuple[str, ...]] = None  # function domain (strings)
+    index_set2: Optional[Tuple[str, ...]] = None  # second level, if any
 
     @property
     def n_components(self) -> int:
-        return len(self.index_set) if self.index_set is not None else 1
+        if self.index_set is None:
+            return 1
+        n = len(self.index_set)
+        if self.index_set2 is not None:
+            n *= len(self.index_set2)
+        return n
 
 
 @dataclasses.dataclass(frozen=True)
 class Action:
-    """One disjunct of Next: guard + updates, optionally parameterized.
+    """One disjunct of Next: guard + updates, with 0..2 bound parameters.
 
-    `param` is the bound variable name (e.g. "self") and `param_values`
-    the finite set it ranges over; unparameterized actions have both None.
-    `updates` maps var name -> update AST; a var absent from updates is
-    UNCHANGED.  The update AST is the full primed RHS (so EXCEPT updates
-    keep their frame implicitly).
+    `params` are the bound variable names (e.g. ("self",) or
+    ("self", "voter") for pairwise actions like Raft vote handling) and
+    `param_values` the finite sets they range over (parallel tuples); a
+    lane exists per binding in their product.  `updates` maps var name ->
+    update AST; a var absent from updates is UNCHANGED.  The update AST
+    is the full primed RHS (so EXCEPT updates keep their frame
+    implicitly).
     """
 
     name: str
-    param: Optional[str]
-    param_values: Optional[Tuple[str, ...]]
+    params: Tuple[str, ...]
+    param_values: Tuple[Tuple[str, ...], ...]
     guard: tuple  # texpr AST, boolean
     updates: Dict[str, tuple]  # var -> texpr AST for the new value
+
+    def bindings(self):
+        """All parameter-binding dicts (the lane enumeration)."""
+        if not self.params:
+            return [{}]
+        out = [{}]
+        for name, values in zip(self.params, self.param_values):
+            out = [{**b, name: v} for b in out for v in values]
+        return out
 
 
 @dataclasses.dataclass(frozen=True)

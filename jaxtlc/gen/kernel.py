@@ -114,19 +114,33 @@ def compile_expr(ast, ctx: _Ctx):
             )
         return _load_component(cdc, decl, cdc.comp_index(name, None))
     if op == "apply":
-        base, idx_ast = ast[1], ast[2]
+        # collect an application chain f[i] / f[i][j] down to the variable
+        idx_asts = []
+        base = ast
+        while isinstance(base, tuple) and base[0] == "apply":
+            idx_asts.insert(0, base[2])
+            base = base[1]
         if base[0] != "var":
             raise CompileError("only variable application is compilable")
         name = base[1]
         decl = _find_var(cdc.spec, name)
         if decl is None or decl.index_set is None:
             raise CompileError(f"{name} is not a function variable")
-        ok, idx = _try_static(idx_ast, ctx)
-        if not ok:
+        want = 2 if decl.index_set2 is not None else 1
+        if len(idx_asts) != want:
             raise CompileError(
-                f"{name}[...]: index must be compile-time static"
+                f"{name}: expected {want} application level(s), "
+                f"got {len(idx_asts)}"
             )
-        return _load_component(cdc, decl, cdc.comp_index(name, idx))
+        idxs = []
+        for ia in idx_asts:
+            ok, idx = _try_static(ia, ctx)
+            if not ok:
+                raise CompileError(
+                    f"{name}[...]: index must be compile-time static"
+                )
+            idxs.append(idx)
+        return _load_component(cdc, decl, cdc.comp_index(name, *idxs))
     if op in ("and", "or", "implies"):
         ka, fa = compile_expr(ast[1], ctx)
         kb, fb = compile_expr(ast[2], ctx)
@@ -251,12 +265,12 @@ class GenKernel(NamedTuple):
 
 
 def make_gen_kernel(spec: GenSpec, codec: GenCodec) -> GenKernel:
+    from .oracle import binding_label
+
     consts = dict(spec.constants)
     lanes = []  # (label, action_idx, guard_fn, [per-comp code fn or None])
     for ai, act in enumerate(spec.actions):
-        bindings = [None] if act.param is None else list(act.param_values)
-        for b in bindings:
-            binding = {} if b is None else {act.param: b}
+        for binding in act.bindings():
             ctx = _Ctx(codec, consts, binding, None)
             k, guard_fn = compile_expr(act.guard, ctx)
             if k != "bool":
@@ -268,8 +282,9 @@ def make_gen_kernel(spec: GenSpec, codec: GenCodec) -> GenKernel:
                 for entry in _compile_update(var, upd_ast, ctx):
                     comp, code_fn, ok_fn = entry
                     comp_fns[comp] = (code_fn, ok_fn)
-            label = act.name if b is None else f"{act.name}({b})"
-            lanes.append((label, ai, guard_fn, comp_fns))
+            lanes.append(
+                (binding_label(act, binding), ai, guard_fn, comp_fns)
+            )
 
     L = len(lanes)
     F = codec.n_fields
@@ -346,6 +361,37 @@ def _coder(decl, codec: GenCodec):
     return make
 
 
+def _static_idx(ia, ctx: _Ctx, var: str):
+    ok, idx = _try_static(ia, ctx)
+    if not ok:
+        raise CompileError(
+            f"{var}' EXCEPT index must be compile-time static"
+        )
+    return idx
+
+
+def _compile_fnlit_body(var, decl, make, ctx, bound, body, row=None):
+    """Components for [x \\in S |-> body] over one function level (row
+    pins the first index for two-level variables)."""
+    cdc = ctx.codec
+    out = []
+    if row is None and decl.index_set2 is not None:
+        raise CompileError(
+            f"{var}': two-level variable needs a nested function literal"
+        )
+    index = decl.index_set if row is None else decl.index_set2
+    for idx in index:
+        b2 = dict(ctx.binding)
+        b2[bound] = idx
+        inner = ctx._replace(binding=b2)
+        comp = (cdc.comp_index(var, idx) if row is None
+                else cdc.comp_index(var, row, idx))
+        k, val_fn = compile_expr(body, inner)
+        code_fn, ok_fn = make(k, val_fn)
+        out.append((comp, code_fn, ok_fn))
+    return out
+
+
 def _compile_update(var: str, upd_ast, ctx: _Ctx):
     """Yields (component, code_fn, ok_fn) triples for one `var' = rhs`."""
     cdc = ctx.codec
@@ -359,20 +405,39 @@ def _compile_update(var: str, upd_ast, ctx: _Ctx):
         code_fn, ok_fn = make(k, val_fn)
         out.append((cdc.comp_index(var, None), code_fn, ok_fn))
         return out
+    two_level = decl.index_set2 is not None
     # function variable: EXCEPT, fnlit, or whole-copy of another function
     if upd_ast[0] == "except" and upd_ast[1][0] == "var":
         src = upd_ast[1][1]
+        sdecl = _find_var(cdc.spec, src)
         if src != var:
             out.extend(_copy_fn(var, src, ctx))
-        for idx_ast, val_ast in upd_ast[2]:
-            ok, idx = _try_static(idx_ast, ctx)
-            if not ok:
-                raise CompileError(
-                    f"{var}' EXCEPT index must be compile-time static"
+        for idxs_ast, val_ast in upd_ast[2]:
+            idxs = [_static_idx(ia, ctx, var) for ia in idxs_ast]
+            if len(idxs) == 1 and two_level:
+                # row update: ![i] = [j \in T |-> e]
+                if val_ast[0] != "fnlit":
+                    raise CompileError(
+                        f"{var}' EXCEPT ![i] on a two-level variable "
+                        "needs a function-literal row"
+                    )
+                _, bound, dom_ast, body = val_ast
+                ok, dom = _try_static(dom_ast, ctx)
+                if not ok or set(dom) != set(decl.index_set2):
+                    raise CompileError(f"{var}' row domain mismatch")
+                row_entries = _compile_fnlit_body(
+                    var, decl, make, ctx, bound, body, row=idxs[0]
                 )
-            comp = cdc.comp_index(var, idx)
-            sdecl = _find_var(cdc.spec, src)
-            at = _load_component(cdc, sdecl, cdc.comp_index(src, idx))
+                touched = {e[0] for e in row_entries}
+                out = [e for e in out if e[0] not in touched]
+                out.extend(row_entries)
+                continue
+            if len(idxs) != (2 if two_level else 1):
+                raise CompileError(
+                    f"{var}' EXCEPT: wrong number of indices"
+                )
+            comp = cdc.comp_index(var, *idxs)
+            at = _load_component(cdc, sdecl, cdc.comp_index(src, *idxs))
             k, val_fn = compile_expr(val_ast, ctx._replace(at=at))
             code_fn, ok_fn = make(k, val_fn)
             out = [e for e in out if e[0] != comp]
@@ -385,12 +450,25 @@ def _compile_update(var: str, upd_ast, ctx: _Ctx):
             raise CompileError(f"{var}' function domain must be static")
         if set(dom) != set(decl.index_set):
             raise CompileError(f"{var}' domain mismatch with TypeOK")
-        for idx in decl.index_set:
+        if not two_level:
+            return _compile_fnlit_body(var, decl, make, ctx, bound, body)
+        # [i \in S |-> [j \in T |-> e]]
+        if body[0] != "fnlit":
+            raise CompileError(
+                f"{var}': two-level variable needs a nested function "
+                "literal"
+            )
+        _, bound2, dom2_ast, body2 = body
+        for i in decl.index_set:
             b2 = dict(ctx.binding)
-            b2[bound] = idx
-            k, val_fn = compile_expr(body, ctx._replace(binding=b2))
-            code_fn, ok_fn = make(k, val_fn)
-            out.append((cdc.comp_index(var, idx), code_fn, ok_fn))
+            b2[bound] = i
+            inner = ctx._replace(binding=b2)
+            ok, dom2 = _try_static(dom2_ast, inner)
+            if not ok or set(dom2) != set(decl.index_set2):
+                raise CompileError(f"{var}' inner domain mismatch")
+            out.extend(_compile_fnlit_body(
+                var, decl, make, inner, bound2, body2, row=i
+            ))
         return out
     if upd_ast[0] == "var":
         return _copy_fn(var, upd_ast[1], ctx)
@@ -401,14 +479,25 @@ def _copy_fn(dst: str, src: str, ctx: _Ctx):
     cdc = ctx.codec
     ddecl = _find_var(cdc.spec, dst)
     sdecl = _find_var(cdc.spec, src)
-    if sdecl is None or sdecl.index_set != ddecl.index_set:
+    if (sdecl is None or sdecl.index_set != ddecl.index_set
+            or sdecl.index_set2 != ddecl.index_set2):
         raise CompileError(f"{dst}' = {src}: index sets differ")
     make = _coder(ddecl, cdc)
     out = []
-    for idx in ddecl.index_set:
-        k, val_fn = _load_component(cdc, sdecl, cdc.comp_index(src, idx))
+    if ddecl.index_set2 is None:
+        pairs = [(idx, None) for idx in ddecl.index_set]
+    else:
+        pairs = [(i, j) for i in ddecl.index_set
+                 for j in ddecl.index_set2]
+    for i, j in pairs:
+        if j is None:
+            scomp, dcomp = cdc.comp_index(src, i), cdc.comp_index(dst, i)
+        else:
+            scomp = cdc.comp_index(src, i, j)
+            dcomp = cdc.comp_index(dst, i, j)
+        k, val_fn = _load_component(cdc, sdecl, scomp)
         code_fn, ok_fn = make(k, val_fn)
-        out.append((cdc.comp_index(dst, idx), code_fn, ok_fn))
+        out.append((dcomp, code_fn, ok_fn))
     return out
 
 

@@ -54,10 +54,10 @@ def initial_state(spec: GenSpec) -> State:
     return tuple(vals)
 
 
-def _bindings(act: Action):
-    if act.param is None:
-        return [None]
-    return list(act.param_values)
+def binding_label(act: Action, b: dict) -> str:
+    if not b:
+        return act.name
+    return f"{act.name}({','.join(str(b[p]) for p in act.params)})"
 
 
 def successors(spec: GenSpec, st: State):
@@ -67,10 +67,9 @@ def successors(spec: GenSpec, st: State):
     out = []
     base = state_env(spec, st)
     for act in spec.actions:
-        for b in _bindings(act):
+        for b in act.bindings():
             env = dict(base)
-            if b is not None:
-                env[act.param] = b
+            env.update(b)
             try:
                 if not texpr.evaluate(act.guard, env):
                     continue
@@ -88,8 +87,7 @@ def successors(spec: GenSpec, st: State):
                         else v
                     )
             nxt = tuple(vals)
-            label = act.name if b is None else f"{act.name}({b})"
-            out.append((label, nxt, nxt != st))
+            out.append((binding_label(act, b), nxt, nxt != st))
     return out
 
 

@@ -45,35 +45,121 @@ def _strip_comments(text: str) -> str:
     return re.sub(r"\\\*[^\n]*", "", text)
 
 
-def split_definitions(text: str) -> Dict[str, Tuple[Optional[str], str]]:
-    """{name: (param or None, body)} for every top-level definition."""
-    out: Dict[str, Tuple[Optional[str], str]] = {}
+def split_definitions(text: str) -> Dict[str, Tuple[Optional[tuple], str]]:
+    """{name: (params or None, RAW body)} for every top-level definition.
+
+    Bodies keep their line structure: TLA bullet lists (`/\\` items at a
+    common column) are line-delimited, and collapsing them early loses
+    the boundary between `... \\/ ...` INSIDE one item and the next item
+    (the round-4 Raft quantifier bug)."""
+    out: Dict[str, Tuple[Optional[tuple], str]] = {}
     matches = list(_DEF_RE.finditer(text))
     for i, m in enumerate(matches):
         end = matches[i + 1].start() if i + 1 < len(matches) else len(text)
-        body = text[m.end():end]
-        body = body.split("====")[0].strip()
+        # pad the header with spaces so a bullet on the definition line
+        # keeps its true file column (bullet lists align by column)
+        line_start = text.rfind("\n", 0, m.start()) + 1
+        body = " " * (m.end() - line_start) + text[m.end():end]
+        body = body.split("====")[0].rstrip()
         params = m.group("params")
         if params is not None:
-            params = params.strip()
-            if "," in params:
+            names = [p.strip() for p in params.split(",") if p.strip()]
+            if len(names) > 2:
                 raise SpecParseError(
-                    f"{m.group('name')}: at most one action parameter "
-                    "is supported"
+                    f"{m.group('name')}: at most two action parameters "
+                    "are supported"
                 )
-        out[m.group("name")] = (params or None, " ".join(body.split()))
+            params = tuple(names) or None
+        out[m.group("name")] = (params, body)
     return out
+
+
+def _line_depth_delta(ln: str) -> int:
+    """Bracket-nesting delta of one line ((), [], {}, << >>)."""
+    d, i, n = 0, 0, len(ln)
+    while i < n:
+        two = ln[i:i + 2]
+        if two in ("<<", ">>"):
+            d += 1 if two == "<<" else -1
+            i += 2
+            continue
+        c = ln[i]
+        if c in "([{":
+            d += 1
+        elif c in ")]}":
+            d -= 1
+        i += 1
+    return d
+
+
+def split_bullets(raw: str, op: str):
+    """Split a RAW (multi-line) body on its outermost bullet list of `op`
+    (`/\\` or `\\/`): items start at lines whose first token is `op` at
+    the minimal such column AND at bracket depth 0 (a continuation line
+    inside an open bracket is never an item boundary); remaining lines
+    attach to their item.  Returns collapsed item strings, or None if the
+    body has no leading bullet list of that operator."""
+    lines = raw.splitlines()
+    starts = []
+    depth = 0
+    for i, ln in enumerate(lines):
+        s = ln.lstrip()
+        if depth == 0 and s.startswith(op):
+            starts.append((i, len(ln) - len(s)))
+        depth += _line_depth_delta(ln)
+    if not starts:
+        return None
+    mincol = min(c for _, c in starts)
+    idxs = [i for i, c in starts if c == mincol]
+    # a bullet LIST: nothing but whitespace before the first item
+    if any(lines[i].strip() for i in range(idxs[0])):
+        return None
+    items = []
+    for k, i in enumerate(idxs):
+        end = idxs[k + 1] if k + 1 < len(idxs) else len(lines)
+        chunk = [lines[i].lstrip()[len(op):]] + lines[i + 1:end]
+        items.append(" ".join(" ".join(chunk).split()))
+    return items
+
+
+def _flat(body: str) -> str:
+    """Whitespace-collapsed single-line view of a raw body."""
+    return " ".join(body.split())
+
+
+def split_conjuncts(raw: str) -> List[str]:
+    """Top-level conjuncts of a definition body, bullet-list-aware.
+    Bullet items are re-split flat so one-line `/\\ a /\\ b` bodies keep
+    their conjunct boundaries (split_top is quantifier-aware, so an
+    item's trailing quantifier body is never cut)."""
+    items = split_bullets(raw, "/\\")
+    if items is None:
+        items = [_flat(raw)]
+    return [p for it in items for p in split_top(it, "/\\")]
+
+
+def split_disjuncts(raw: str) -> List[str]:
+    items = split_bullets(raw, "\\/")
+    if items is None:
+        items = [_flat(raw)]
+    return [p for it in items for p in split_top(it, "\\/")]
 
 
 def split_top(body: str, op: str) -> List[str]:
     """Split on a top-level binary operator (`/\\` or `\\/`), respecting
     (), [], {}, << >> nesting.  A leading operator (TLA bullet-list style)
-    is allowed."""
+    is allowed.  A top-level quantifier ends the splitting: its body is
+    maximal, so every later operator on the line belongs to it."""
     parts, depth, i, cur = [], 0, 0, []
     n = len(body)
     while i < n:
         c = body[i]
         two = body[i:i + 2]
+        if depth == 0 and two in ("\\A", "\\E") and (
+            i + 2 >= n or not (body[i + 2].isalnum() or body[i + 2] == "_")
+        ):
+            cur.append(body[i:])
+            break
         if c in "([{":
             depth += 1
         elif c in ")]}":
@@ -125,7 +211,16 @@ _EXISTS_RE = re.compile(
     r"^\(\s*\\E\s+(?P<var>\w+)\s+\\in\s+(?P<dom>[^:]+):\s*"
     r"(?P<call>[A-Za-z_]\w*)\s*\(\s*(?P=var)\s*\)\s*\)$"
 )
-_CALL_RE = re.compile(r"^(?P<name>[A-Za-z_]\w*)\s*(?:\(\s*(?P<arg>\w+)\s*\))?$")
+# nested two-parameter form: (\E i \in S : (\E j \in T : act(i, j)))
+_EXISTS2_RE = re.compile(
+    r"^\(\s*\\E\s+(?P<v1>\w+)\s+\\in\s+(?P<d1>[^:]+):\s*"
+    r"\(\s*\\E\s+(?P<v2>\w+)\s+\\in\s+(?P<d2>[^:]+):\s*"
+    r"(?P<call>[A-Za-z_]\w*)\s*\(\s*(?P=v1)\s*,\s*(?P=v2)\s*\)\s*\)\s*\)$"
+)
+_CALL_RE = re.compile(
+    r"^(?P<name>[A-Za-z_]\w*)\s*"
+    r"(?:\(\s*(?P<arg>\w+)\s*(?:,\s*(?P<arg2>\w+)\s*)?\))?$"
+)
 
 
 def _balanced(s: str) -> bool:
@@ -192,18 +287,29 @@ class ModuleParser:
     # -- expression helper ------------------------------------------------
 
     def expr(self, src: str, extra: Dict[str, object] = None) -> tuple:
-        src = src.strip()
-        # fold top-level bullet conjunctions/disjunctions (nested bullet
-        # lists must be parenthesized - documented subset restriction).
-        # \/ splits FIRST: it binds looser than /\, so `a \/ b /\ c`
-        # must become or(a, and(b, c)), not and(or(a, b), c)
-        for op, node in (("\\/", "or"), ("/\\", "and")):
-            parts = split_top(src, op)
-            if len(parts) > 1:
-                ast = self.expr(parts[0], extra)
-                for p in parts[1:]:
-                    ast = (node, ast, self.expr(p, extra))
-                return ast
+        # multi-line bullet lists split line-aware (each item keeps its
+        # own internal \/ and quantifier bodies intact)
+        if "\n" in src:
+            for op, node in (("/\\", "and"), ("\\/", "or")):
+                items = split_bullets(src, op)
+                if items is not None and len(items) >= 1:
+                    ast = self.expr(items[0], extra)
+                    for p in items[1:]:
+                        ast = (node, ast, self.expr(p, extra))
+                    return ast
+        src = _flat(src)
+        # a leading quantifier owns the whole rest of the expression
+        # (maximal body) - no top-level operator splitting inside it
+        if not (src.startswith("\\A") or src.startswith("\\E")):
+            # \/ splits FIRST: it binds looser than /\, so `a \/ b /\ c`
+            # must become or(a, and(b, c)), not and(or(a, b), c)
+            for op, node in (("\\/", "or"), ("/\\", "and")):
+                parts = split_top(src, op)
+                if len(parts) > 1:
+                    ast = self.expr(parts[0], extra)
+                    for p in parts[1:]:
+                        ast = (node, ast, self.expr(p, extra))
+                    return ast
         ast = texpr.parse(src)
         env = dict(self.const_env)
         if extra:
@@ -226,7 +332,7 @@ class ModuleParser:
             )
         _, body = self.defs["TypeOK"]
         decls: Dict[str, VarDecl] = {}
-        for conj in split_top(body, "/\\"):
+        for conj in split_conjuncts(body):
             m = re.match(r"^(\w+)\s+\\in\s+(.+)$", conj, re.S)
             if not m:
                 raise SpecParseError(f"unsupported TypeOK conjunct: {conj}")
@@ -234,20 +340,32 @@ class ModuleParser:
             if var not in self.var_names:
                 raise SpecParseError(f"TypeOK names unknown variable {var}")
             fm = re.match(r"^\[(.+?)\s*->\s*(.+)\]$", dom_src, re.S)
+            index_set = index_set2 = None
             if fm:
                 idx = self.eval_const(fm.group(1))
-                dom = self.eval_const(fm.group(2))
                 if not isinstance(idx, frozenset):
                     raise SpecParseError(f"{var}: function index not a set")
                 index_set = tuple(sorted(idx))
+                inner = fm.group(2).strip()
+                fm2 = re.match(r"^\[(.+?)\s*->\s*(.+)\]$", inner, re.S)
+                if fm2:
+                    # two-level function [S -> [T -> D]]
+                    idx2 = self.eval_const(fm2.group(1))
+                    if not isinstance(idx2, frozenset):
+                        raise SpecParseError(
+                            f"{var}: inner function index not a set"
+                        )
+                    index_set2 = tuple(sorted(idx2))
+                    dom = self.eval_const(fm2.group(2))
+                else:
+                    dom = self.eval_const(inner)
             else:
                 dom = self.eval_const(dom_src)
-                index_set = None
             if isinstance(dom, frozenset):
                 vals = tuple(sorted(dom, key=lambda x: (str(type(x)), x)))
             else:
                 raise SpecParseError(f"{var}: domain is not a finite set")
-            decls[var] = VarDecl(var, Domain(vals), index_set)
+            decls[var] = VarDecl(var, Domain(vals), index_set, index_set2)
         missing = [v for v in self.var_names if v not in decls]
         if missing:
             raise SpecParseError(f"TypeOK missing domains for {missing}")
@@ -260,7 +378,7 @@ class ModuleParser:
             raise SpecParseError("no Init definition")
         _, body = self.defs["Init"]
         out: Dict[str, tuple] = {}
-        for conj in split_top(body, "/\\"):
+        for conj in split_conjuncts(body):
             m = re.match(r"^(\w+)\s*=\s*(.+)$", conj, re.S)
             if not m or m.group(1) not in self.var_names:
                 raise SpecParseError(f"unsupported Init conjunct: {conj}")
@@ -272,12 +390,12 @@ class ModuleParser:
 
     # -- actions ----------------------------------------------------------
 
-    def parse_action_body(self, name: str, param: Optional[str],
+    def parse_action_body(self, name: str, params: Optional[Tuple[str, ...]],
                           body: str) -> Action:
         guards: List[tuple] = []
         updates: Dict[str, tuple] = {}
         explicit_unchanged: List[str] = []
-        for conj in split_top(body, "/\\"):
+        for conj in split_conjuncts(body):
             um = _UNCHANGED_RE.match(conj)
             if um:
                 if um.group("name"):
@@ -307,65 +425,81 @@ class ModuleParser:
         guard = guards[0] if guards else ("bool", True)
         for g in guards[1:]:
             guard = ("and", guard, g)
-        return Action(name, param, None, guard, updates)
+        return Action(name, params or (), (), guard, updates)
 
     def parse_next(self) -> List[Action]:
         if "Next" not in self.defs:
             raise SpecParseError("no Next definition")
         _, body = self.defs["Next"]
         actions: List[Action] = []
-        for disj in split_top(body, "\\/"):
-            actions.extend(self._expand_disjunct(disj, None, None))
+        for disj in split_disjuncts(body):
+            actions.extend(self._expand_disjunct(disj, (), ()))
         return actions
 
-    def _expand_disjunct(self, disj: str, param: Optional[str],
-                         param_values: Optional[Tuple[str, ...]]
+    def _exists_domain(self, src: str) -> Tuple[str, ...]:
+        dom = self.eval_const(src.strip())
+        if not isinstance(dom, frozenset):
+            raise SpecParseError("\\E domain is not a finite set")
+        return tuple(sorted(dom))
+
+    def _expand_disjunct(self, disj: str, params: Tuple[str, ...],
+                         param_values: Tuple[Tuple[str, ...], ...]
                          ) -> List[Action]:
         disj = disj.strip()
+        em2 = _EXISTS2_RE.match(disj)
+        if em2:
+            return self._expand_call(
+                em2.group("call"),
+                (em2.group("v1"), em2.group("v2")),
+                (self._exists_domain(em2.group("d1")),
+                 self._exists_domain(em2.group("d2"))),
+            )
         em = _EXISTS_RE.match(disj)
-        if em is None and disj.startswith("(") and disj.endswith(")"):
+        if em:
+            return self._expand_call(
+                em.group("call"), (em.group("var"),),
+                (self._exists_domain(em.group("dom")),),
+            )
+        if disj.startswith("(") and disj.endswith(")"):
             # parenthesized group: recurse on the inner disjunction
             inner = disj[1:-1].strip()
             out = []
-            for p in split_top(inner, "\\/"):
-                out.extend(self._expand_disjunct(p, param, param_values))
+            for p in split_disjuncts(inner):
+                out.extend(self._expand_disjunct(p, params, param_values))
             return out
-        if em:
-            dom = self.eval_const(em.group("dom").strip())
-            if not isinstance(dom, frozenset):
-                raise SpecParseError("\\E domain is not a finite set")
-            return self._expand_call(
-                em.group("call"), em.group("var"), tuple(sorted(dom))
-            )
         cm = _CALL_RE.match(disj)
         if cm:
             name = cm.group("name")
             if name not in self.defs:
                 raise SpecParseError(f"Next references unknown {name}")
-            if cm.group("arg"):
-                if param is None or cm.group("arg") != param:
-                    raise SpecParseError(
-                        f"{name}({cm.group('arg')}): unbound parameter"
-                    )
-            return self._expand_call(name, param, param_values)
+            args = tuple(a for a in (cm.group("arg"), cm.group("arg2")) if a)
+            if any(a not in params for a in args):
+                raise SpecParseError(f"{name}{args}: unbound parameter")
+            return self._expand_call(name, params, param_values)
         raise SpecParseError(f"unsupported Next disjunct: {disj}")
 
-    def _expand_call(self, name: str, param: Optional[str],
-                     param_values: Optional[Tuple[str, ...]]) -> List[Action]:
-        dparam, body = self.defs[name]
+    def _expand_call(self, name: str, params: Tuple[str, ...],
+                     param_values: Tuple[Tuple[str, ...], ...]
+                     ) -> List[Action]:
+        dparams, body = self.defs[name]
+        dparams = dparams or ()
         # a definition that is itself a disjunction of calls (action group)
-        parts = [_strip_outer(p) for p in split_top(body, "\\/")]
+        parts = [_strip_outer(p) for p in split_disjuncts(body)]
         if len(parts) > 1 and all(_CALL_RE.match(p) for p in parts):
             out = []
             for p in parts:
                 callee = _CALL_RE.match(p).group("name")
                 if callee not in self.defs:
                     raise SpecParseError(f"{name} references unknown {callee}")
-                out.extend(self._expand_call(callee, param, param_values))
+                out.extend(self._expand_call(callee, params, param_values))
             return out
-        act = self.parse_action_body(name, dparam, body)
-        return [Action(act.name, dparam, param_values, act.guard,
-                       act.updates)]
+        if len(dparams) > len(param_values):
+            raise SpecParseError(
+                f"{name}({', '.join(dparams)}): unbound parameter"
+            )
+        act = self.parse_action_body(name, dparams, body)
+        return [Action(act.name, dparams, param_values[: len(dparams)],
+                       act.guard, act.updates)]
 
     # -- invariants + properties -----------------------------------------
 
@@ -399,13 +533,25 @@ class ModuleParser:
             domset = ("set", [lit(v) for v in decl.domain.values])
             if decl.index_set is None:
                 conjs.append(("cmp", r"\in", ("var", decl.name), domset))
-            else:
+            elif decl.index_set2 is None:
                 idxset = ("set", [lit(i) for i in decl.index_set])
                 conjs.append(
                     ("forall", "__i", idxset,
                      ("cmp", r"\in",
                       ("apply", ("var", decl.name), ("var", "__i")),
                       domset))
+                )
+            else:
+                idxset = ("set", [lit(i) for i in decl.index_set])
+                idxset2 = ("set", [lit(i) for i in decl.index_set2])
+                conjs.append(
+                    ("forall", "__i", idxset,
+                     ("forall", "__j", idxset2,
+                      ("cmp", r"\in",
+                       ("apply",
+                        ("apply", ("var", decl.name), ("var", "__i")),
+                        ("var", "__j")),
+                       domset)))
                 )
         ast = conjs[0]
         for c in conjs[1:]:
@@ -419,6 +565,7 @@ class ModuleParser:
             if name not in self.defs:
                 raise SpecParseError(f"PROPERTY {name} not defined")
             _, body = self.defs[name]
+            body = _flat(body)
             qm = re.match(
                 r"^\\A\s+(\w+)\s+\\in\s+([^:]+):\s*(.+)$", body, re.S
             )

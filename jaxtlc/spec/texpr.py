@@ -237,6 +237,8 @@ class _Parser:
                 return ("bool", True)
             if v == "FALSE":
                 return ("bool", False)
+            if v == "BOOLEAN":
+                return ("set", [("bool", False), ("bool", True)])
             if v in ("Cardinality", "Len") and self.peek() == ("sym", "("):
                 self.next()
                 arg = self.parse_implies()
@@ -291,13 +293,18 @@ class _Parser:
             while True:
                 if self.next() != ("sym", "!"):
                     raise TexprError("expected ! in EXCEPT")
-                self.expect("[")
-                idx = self.parse_implies()
-                self.expect("]")
+                idxs = []
+                while self.peek() == ("sym", "["):
+                    self.next()
+                    idxs.append(self.parse_implies())
+                    self.expect("]")
+                if not idxs:
+                    raise TexprError("expected [index] in EXCEPT")
                 if self.next()[0] != "eq":
                     raise TexprError("expected = in EXCEPT")
                 val = self.parse_implies()
-                updates.append((idx, val))
+                # multi-index ![i][j] = nested single-index updates
+                updates.append((idxs, val))
                 nk, nv = self.next()
                 if (nk, nv) == ("sym", "]"):
                     break
@@ -484,24 +491,9 @@ def evaluate(ast, env: dict, env_next: Optional[dict] = None):
         raise TexprError("function domain must be strings or 1..n")
     if op == "except":
         f = evaluate(ast[1], env, env_next)
-        for idx_ast, val_ast in ast[2]:
-            idx = evaluate(idx_ast, env, env_next)
-            old = _apply(f, idx)
-            e2 = dict(env)
-            e2["@"] = old
-            en2 = (dict(env_next, **{"@": old})
-                   if env_next is not None else None)
-            val = evaluate(val_ast, e2, en2)
-            if isinstance(f, tuple) and f and all(
-                isinstance(x, tuple) and len(x) == 2
-                and isinstance(x[0], str) for x in f
-            ):
-                f = tuple(sorted(((k, val if k == idx else v)
-                                  for k, v in f)))
-            elif isinstance(f, tuple) and isinstance(idx, int):
-                f = f[: idx - 1] + (val,) + f[idx:]
-            else:
-                raise TexprError("EXCEPT on a non-function")
+        for idxs_ast, val_ast in ast[2]:
+            idxs = [evaluate(i, env, env_next) for i in idxs_ast]
+            f = _except_update(f, idxs, val_ast, env, env_next)
         return f
     if op == "atref":
         if "@" not in env:
@@ -523,6 +515,29 @@ def evaluate(ast, env: dict, env_next: Optional[dict] = None):
             return a <= b
         return {"<": a < b, ">": a > b, "<=": a <= b, ">=": a >= b}[sym]
     raise TexprError(f"unhandled AST node {op!r}")
+
+
+def _except_update(f, idxs, val_ast, env, env_next):
+    """[f EXCEPT ![i1][i2]... = val]: nested single-level updates; @ in
+    val reads the innermost old value."""
+    idx = idxs[0]
+    old = _apply(f, idx)
+    if len(idxs) > 1:
+        val = _except_update(old, idxs[1:], val_ast, env, env_next)
+    else:
+        e2 = dict(env)
+        e2["@"] = old
+        en2 = (dict(env_next, **{"@": old})
+               if env_next is not None else None)
+        val = evaluate(val_ast, e2, en2)
+    if isinstance(f, tuple) and f and all(
+        isinstance(x, tuple) and len(x) == 2 and isinstance(x[0], str)
+        for x in f
+    ):
+        return tuple(sorted(((k, val if k == idx else v) for k, v in f)))
+    if isinstance(f, tuple) and isinstance(idx, int):
+        return f[: idx - 1] + (val,) + f[idx:]
+    raise TexprError("EXCEPT on a non-function")
 
 
 def _as_bool(v):

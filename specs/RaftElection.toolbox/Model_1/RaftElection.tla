@@ -1,0 +1,98 @@
+---- MODULE RaftElection ----
+\* Raft leader election (the third config family from BASELINE.json:
+\* "etcd Raft TLA+ spec (leader election + log replication)") - the
+\* leader-election half, written in the jaxtlc generic-frontend subset
+\* with its two-level-function / two-parameter-action extension.  Log
+\* replication needs unbounded sequences and is out of the finite-domain
+\* subset (documented scope).
+\*
+\* The RequestVote RPC is modeled shared-memory style: the voter reads
+\* the candidate's term directly and grants atomically (the interleaving
+\* of grants across voters - the race TLC explores - is preserved; the
+\* network reordering dimension is abstracted away).
+\*
+\* Quorum is hardwired to "two distinct grants", the correct majority for
+\* the 3-node configurations this model checks (general-N quorums need
+\* Cardinality over set-valued state, outside the kernel subset).
+EXTENDS Naturals
+
+CONSTANTS Nodes, MaxTerm
+
+VARIABLES state, term, votedFor, voteGranted
+
+vars == << state, term, votedFor, voteGranted >>
+
+TypeOK == /\ state \in [Nodes -> {"Follower", "Candidate", "Leader"}]
+          /\ term \in [Nodes -> 0..MaxTerm]
+          /\ votedFor \in [Nodes -> {"none"} \cup Nodes]
+          /\ voteGranted \in [Nodes -> [Nodes -> BOOLEAN]]
+
+Init == /\ state = [i \in Nodes |-> "Follower"]
+        /\ term = [i \in Nodes |-> 0]
+        /\ votedFor = [i \in Nodes |-> "none"]
+        /\ voteGranted = [i \in Nodes |-> [j \in Nodes |-> FALSE]]
+
+\* A non-leader times out: next term, candidacy, fresh tally with its own
+\* vote (j = self grants exactly the self-vote).
+Timeout(self) ==
+    /\ state[self] # "Leader"
+    /\ term[self] < MaxTerm
+    /\ term' = [term EXCEPT ![self] = @ + 1]
+    /\ state' = [state EXCEPT ![self] = "Candidate"]
+    /\ votedFor' = [votedFor EXCEPT ![self] = self]
+    /\ voteGranted' = [voteGranted EXCEPT ![self] = [j \in Nodes |-> j = self]]
+
+\* voter handles self's RequestVote: grant if the voter's term is behind,
+\* or equal with no conflicting vote.  Granting adopts the candidate's
+\* term and demotes the voter to follower (Raft's step-down rule).
+HandleVote(self, voter) ==
+    /\ state[self] = "Candidate"
+    /\ voter # self
+    /\ ~voteGranted[self][voter]
+    /\ term[voter] < term[self] \/ (term[voter] = term[self] /\ (votedFor[voter] = "none" \/ votedFor[voter] = self))
+    /\ term' = [term EXCEPT ![voter] = term[self]]
+    /\ state' = [state EXCEPT ![voter] = "Follower"]
+    /\ votedFor' = [votedFor EXCEPT ![voter] = self]
+    /\ voteGranted' = [voteGranted EXCEPT ![self][voter] = TRUE]
+
+\* Two distinct grants (incl. the self-vote) = majority of 3.
+BecomeLeader(self) ==
+    /\ state[self] = "Candidate"
+    /\ \E i \in Nodes : \E j \in Nodes : (i # j /\ voteGranted[self][i] /\ voteGranted[self][j])
+    /\ state' = [state EXCEPT ![self] = "Leader"]
+    /\ UNCHANGED << term, votedFor, voteGranted >>
+
+\* Converged-or-exhausted stutter: exactly the states where Timeout is
+\* disabled for every node, so the model is deadlock-free by construction
+\* (split votes at MaxTerm park here forever - admissible under WF).
+Terminating ==
+    /\ \A i \in Nodes : state[i] = "Leader" \/ term[i] = MaxTerm
+    /\ UNCHANGED vars
+
+node(self) == Timeout(self) \/ BecomeLeader(self)
+
+Next == Terminating
+          \/ (\E self \in Nodes : node(self))
+          \/ (\E self \in Nodes : (\E voter \in Nodes : HandleVote(self, voter)))
+
+Spec == Init /\ [][Next]_vars /\ WF_vars(Next)
+
+\* Election safety (the Raft invariant): at most one leader per term.
+ElectionSafety == \A i \in Nodes : \A j \in Nodes :
+    (state[i] = "Leader" /\ state[j] = "Leader" /\ term[i] = term[j]) => i = j
+
+\* A CURRENT candidate's tally only holds votes bound to it: a granter
+\* either still votes for i, or has moved to a later term (terms are
+\* monotone).  Demoted candidates keep stale rows by design - Timeout
+\* resets the row on the next candidacy - so the invariant is scoped to
+\* candidates (the unscoped version is genuinely violated: granting to a
+\* higher-term candidate demotes a voter whose own stale self-grant row
+\* then trips it).
+VoteIntegrity == \A i \in Nodes : \A j \in Nodes :
+    (state[i] = "Candidate" /\ voteGranted[i][j]) => (votedFor[j] = i \/ term[j] # term[i])
+
+\* Liveness under WF(Next) is genuinely VIOLATED: split votes can park at
+\* MaxTerm with no leader forever (the lasso the checker reports).
+EventuallyLeader ==
+    (term["n1"] = 0) ~> (\E i \in Nodes : state[i] = "Leader")
+====

@@ -39,8 +39,8 @@ def test_parse_structure(spec):
     assert spec.var("pc").domain.values == ("Apply", "Idle", "Observe")
     names = [a.name for a in spec.actions]
     assert names == ["Bump", "Terminating", "Wake", "Observe", "Apply"]
-    assert spec.actions[2].param == "self"
-    assert spec.actions[2].param_values == ("c1", "c2")
+    assert spec.actions[2].params == ("self",)
+    assert spec.actions[2].param_values == (("c1", "c2"),)
     assert set(spec.invariants) == {
         "TypeOK", "AppliedBounded", "ObservedBounded"
     }
@@ -192,6 +192,33 @@ def test_scaled_reconciler_parity():
     )
     assert not o.violations and r.violation == 0
     assert r.action_generated == o.action_generated
+
+
+def test_parser_splitting_regressions(spec):
+    """r4 review findings: quantifier bodies are maximal, one-line bullet
+    bodies still split, bracket-spanning lines are not item boundaries."""
+    from jaxtlc.frontend.mc_cfg import parse_cfg_file
+    from jaxtlc.gen.tla_parse import ModuleParser, split_bullets, split_top
+    from jaxtlc.spec import texpr
+
+    with open(TLA) as f:
+        mp = ModuleParser(f.read(), {"Controllers": frozenset({"c1"}),
+                                     "MaxGen": 2}, [], [])
+    # (1) a mid-expression quantifier owns everything after it
+    ast = mp.expr("desired = 1 /\\ \\A i \\in {1, 2} : i = 0 \\/ desired = 0")
+    assert ast[0] == "and"
+    assert ast[2][0] == "forall"
+    assert ast[2][3][0] == "or"  # the \/ stayed INSIDE the body
+    env = {"desired": 0}
+    assert texpr.evaluate(ast, env) is False  # not or(and(...), d=0)
+    # (2) one-line bulleted bodies keep their conjunct boundaries
+    from jaxtlc.gen.tla_parse import split_conjuncts
+
+    parts = split_conjuncts("/\\ x < 3 /\\ y = 1")
+    assert parts == ["x < 3", "y = 1"]
+    # (3) a bullet op on a continuation line inside brackets is no boundary
+    items = split_bullets("\\/ (A\n\\/ A)", "\\/")
+    assert items == ["(A \\/ A)"]
 
 
 def test_expr_precedence_or_loosest(spec):

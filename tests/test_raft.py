@@ -1,0 +1,178 @@
+"""Raft leader-election spec tests (the third BASELINE.json config family)
+exercising the generic frontend's two-level-function variables and
+two-parameter actions: parser structure, oracle pins, compiled-kernel
+differential on every reachable state, device parity, election-safety
+negative seeding, the genuinely-violated liveness property, and the CLI."""
+
+import os
+
+import numpy as np
+import pytest
+
+SPEC_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "specs", "RaftElection.toolbox", "Model_1",
+)
+TLA = os.path.join(SPEC_DIR, "RaftElection.tla")
+CFG = os.path.join(SPEC_DIR, "MC.cfg")
+
+# oracle-pinned counts for Nodes={n1,n2,n3}, MaxTerm=2
+EXPECT = (1223, 492, 8)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    from jaxtlc.frontend.mc_cfg import parse_cfg_file
+    from jaxtlc.gen.tla_parse import load_genspec
+
+    cfg = parse_cfg_file(CFG)
+    return load_genspec(TLA, cfg.constants, cfg.invariants, cfg.properties)
+
+
+def test_parse_structure(spec):
+    vg = spec.var("voteGranted")
+    assert vg.index_set == ("n1", "n2", "n3")
+    assert vg.index_set2 == ("n1", "n2", "n3")  # two-level function
+    assert vg.domain.values == (False, True)
+    hv = next(a for a in spec.actions if a.name == "HandleVote")
+    assert hv.params == ("self", "voter")
+    assert len(hv.bindings()) == 9  # full product
+    assert set(spec.invariants) == {
+        "TypeOK", "ElectionSafety", "VoteIntegrity"
+    }
+
+
+def test_oracle_counts_and_safety(spec):
+    from jaxtlc.gen import oracle as go
+
+    r = go.bfs(spec)
+    assert (r.generated, r.distinct, r.depth) == EXPECT
+    assert not r.violations
+
+
+def test_kernel_differential_all_states(spec):
+    from collections import deque
+
+    import jax
+    import jax.numpy as jnp
+
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.gen.codec import GenCodec
+    from jaxtlc.gen.kernel import make_gen_kernel
+
+    cdc = GenCodec(spec)
+    ker = make_gen_kernel(spec, cdc)
+    init = go.initial_state(spec)
+    seen = {init}
+    q = deque([init])
+    states = []
+    while q:
+        st = q.popleft()
+        states.append(st)
+        for _, nxt, _ in go.successors(spec, st):
+            if nxt not in seen:
+                seen.add(nxt)
+                q.append(nxt)
+    assert len(states) == EXPECT[1]
+    mat = jnp.asarray(np.stack([cdc.encode(s) for s in states]))
+    succs, valid, ovf = map(np.asarray, jax.jit(jax.vmap(ker.step))(mat))
+    assert not ovf.any()
+    for i, st in enumerate(states):
+        o = sorted((lbl, nxt) for lbl, nxt, _ in go.successors(spec, st))
+        d = sorted(
+            (ker.lane_labels[l], cdc.decode(succs[i, l]))
+            for l in range(ker.n_lanes) if valid[i, l]
+        )
+        assert o == d, f"successor mismatch at {st}"
+    for s in states[:200]:
+        assert cdc.decode(cdc.encode(s)) == s
+
+
+def test_device_engine_parity(spec):
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.gen.engine import check_gen
+
+    r = check_gen(spec, chunk=256, queue_capacity=1 << 12,
+                  fp_capacity=1 << 14)
+    o = go.bfs(spec)
+    assert (r.generated, r.distinct, r.depth) == EXPECT
+    assert r.violation == 0 and r.queue_left == 0
+    assert r.action_generated == o.action_generated
+
+
+def test_maxterm3_parity():
+    from jaxtlc.frontend.mc_cfg import parse_cfg_file
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.gen.engine import check_gen
+    from jaxtlc.gen.tla_parse import load_genspec
+
+    spec = load_genspec(
+        TLA, {"Nodes": "{n1, n2, n3}", "MaxTerm": "3"},
+        ["TypeOK", "ElectionSafety", "VoteIntegrity"], [],
+    )
+    o = go.bfs(spec)
+    assert (o.generated, o.distinct, o.depth) == (7256, 2428, 11)
+    assert not o.violations
+    r = check_gen(spec, chunk=512, queue_capacity=1 << 13,
+                  fp_capacity=1 << 15)
+    assert (r.generated, r.distinct, r.depth) == (7256, 2428, 11)
+    assert r.action_generated == o.action_generated
+
+
+def test_weakened_quorum_breaks_election_safety(tmp_path):
+    """Quorum of one (the self-vote) must yield two same-term leaders -
+    the invariant-and-trace machinery catches a real protocol bug."""
+    from jaxtlc.frontend.mc_cfg import parse_cfg_file
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.gen.engine import check_gen
+    from jaxtlc.gen.tla_parse import load_genspec
+    from jaxtlc.spec import texpr
+
+    with open(TLA) as f:
+        text = f.read()
+    text = text.replace(
+        "/\\ \\E i \\in Nodes : \\E j \\in Nodes : "
+        "(i # j /\\ voteGranted[self][i] /\\ voteGranted[self][j])",
+        "/\\ voteGranted[self][self]",
+    )
+    p = tmp_path / "RaftElection.tla"
+    p.write_text(text)
+    cfg = parse_cfg_file(CFG)
+    spec = load_genspec(str(p), cfg.constants,
+                        ["TypeOK", "ElectionSafety"], [])
+    r = check_gen(spec, chunk=256, queue_capacity=1 << 12,
+                  fp_capacity=1 << 14)
+    assert r.violation >= 100
+    assert "ElectionSafety" in r.violation_name
+    found = go.violation_trace(spec)
+    assert found is not None
+    kind, chain = found
+    assert kind == "ElectionSafety"
+    last = chain[-1][0]
+    assert not texpr.evaluate(
+        spec.invariants["ElectionSafety"], go.state_env(spec, last)
+    )
+
+
+def test_liveness_split_vote_lasso(spec):
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.spec import texpr
+
+    (name, (p, q)), = spec.properties.items()
+    assert name == "EventuallyLeader"
+    res = go.check_leads_to(spec, p, q, name)
+    assert not res.holds  # split votes can park at MaxTerm forever
+    for st in res.lasso_cycle:
+        assert not texpr.evaluate(q, go.state_env(spec, st))
+
+
+def test_cli_raft_liveness_exit13(capsys):
+    from jaxtlc.cli import main
+
+    rc = main(["check", CFG, "-noTool", "-chunk", "256", "-qcap", "4096",
+               "-fpcap", "16384"])
+    out = capsys.readouterr().out
+    assert rc == 13  # safety clean, liveness violated
+    assert "1,223 states generated, 492 distinct states found" in out
+    assert "Temporal properties were violated: EventuallyLeader" in out
+    assert "No error has been found" not in out
