@@ -137,6 +137,7 @@ class TLCLog:
         self.msg(2219, "SANY finished.")
 
     def starting(self) -> None:
+        self._t0 = time.time()
         self.msg(2185, f"Starting... ({time.strftime('%Y-%m-%d %H:%M:%S')})")
 
     def computing_init(self) -> None:
@@ -152,11 +153,34 @@ class TLCLog:
     def progress(
         self, depth: int, generated: int, distinct: int, queue: int
     ) -> None:
+        """TLC's 2200 Progress line incl. the per-minute rates computed
+        from the previous Progress report (MC.out:35,1095)."""
+        now = time.time()
+        prev = getattr(self, "_prev_progress", None)
+        self._prev_progress = (now, generated, distinct)
+        rates = ""
+        if prev is not None and now > prev[0]:
+            dt = now - prev[0]
+            spm = int((generated - prev[1]) * 60 / dt)
+            dpm = int((distinct - prev[2]) * 60 / dt)
+            self._last_rates = (spm, dpm)
+        else:
+            # first report: rates since the start (TLC does the same)
+            t0 = getattr(self, "_t0", None)
+            if t0 is None or now <= t0:
+                self._last_rates = (generated * 60, distinct * 60)
+            else:
+                self._last_rates = (
+                    int(generated * 60 / (now - t0)),
+                    int(distinct * 60 / (now - t0)),
+                )
+        spm, dpm = self._last_rates
         self.msg(
             2200,
             f"Progress({depth}) at {time.strftime('%Y-%m-%d %H:%M:%S')}: "
-            f"{generated:,} states generated, {distinct:,} distinct states "
-            f"found, {queue:,} states left on queue.",
+            f"{generated:,} states generated ({spm:,} s/min), "
+            f"{distinct:,} distinct states found ({dpm:,} ds/min), "
+            f"{queue:,} states left on queue.",
         )
 
     @staticmethod

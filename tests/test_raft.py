@@ -173,6 +173,7 @@ def test_cli_raft_liveness_exit13(capsys):
                "-fpcap", "16384"])
     out = capsys.readouterr().out
     assert rc == 13  # safety clean, liveness violated
-    assert "1,223 states generated, 492 distinct states found" in out
+    assert "1,223 states generated (" in out  # Progress incl. s/min rates
+    assert "492 distinct states found (" in out
     assert "Temporal properties were violated: EventuallyLeader" in out
     assert "No error has been found" not in out
