@@ -298,7 +298,6 @@ def _run_check_gen(args, spec) -> int:
             ("-fpset DiskFPSet", args.fpset != "JaxFPSet"),
             ("-mutation", args.mutation),
             ("-coverage", args.coverage),
-            ("-traceExpressions", args.traceExpressions),
         ) if on
     ]
     if unsupported:
@@ -360,10 +359,40 @@ def _run_check_gen(args, spec) -> int:
             log.msg(1000, "Violation was not reproducible in host mode",
                     severity=1)
         else:
+            expr_rows = None
+            if args.traceExpressions:
+                # trace-explorer re-evaluation over generic-spec states
+                from .gen.oracle import state_env as gen_state_env
+                from .spec.texpr import (
+                    TexprError,
+                    eval_over_envs,
+                    parse_expressions,
+                )
+
+                try:
+                    with open(args.traceExpressions) as f:
+                        exprs = parse_expressions(f.read())
+                    expr_rows = eval_over_envs(
+                        exprs,
+                        [gen_state_env(g, st) for st, _ in found[1]],
+                    )
+                except (OSError, TexprError) as e:
+                    log.msg(1000, f"Trace expressions skipped: {e}",
+                            severity=1)
             for i, (st, act) in enumerate(found[1], start=1):
                 head = (f"State {i}: <Initial predicate>" if act is None
                         else f"State {i}: <{act}>")
-                log.msg(2217, head + "\n" + state_to_tla(g, st), severity=1)
+                text = state_to_tla(g, st)
+                if expr_rows is not None:
+                    from .spec.pretty import value_to_tla
+
+                    text += "".join(
+                        f"\n/\\ {res.name} = "
+                        + (f"<evaluation failed: {res.value}>" if res.failed
+                           else value_to_tla(res.value))
+                        for res in expr_rows[i - 1]
+                    )
+                log.msg(2217, head + "\n" + text, severity=1)
     elif not liveness_violated:
         log.success(r.generated, r.distinct, None)
         log.coverage_generic(spec.spec_name, 1, r.action_generated)

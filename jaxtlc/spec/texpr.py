@@ -576,20 +576,20 @@ class ExprResult(NamedTuple):
     failed: bool
 
 
-def eval_over_trace(
+def eval_over_envs(
     exprs: List[TraceExpression],
-    trace: List[Tuple[State, Optional[str]]],
-    cfg: ModelConfig,
+    envs: List[dict],
 ) -> List[List[ExprResult]]:
-    """Per trace state: [ExprResult(name, value, failed), ...].
+    """Per trace state env: [ExprResult(name, value, failed), ...].
 
     Primed variables in state i read state i+1; the final state reads
     itself (the trailing stuttering step, TLC's convention for the last
     state of a finite trace).  Evaluation failures (including Python-level
     type errors from mis-typed expressions, e.g. `pc["Client"] < 3`)
     degrade to a failed ExprResult carrying the message - one bad
-    expression never loses the trace."""
-    envs = [state_env(st, cfg) for st, _ in trace]
+    expression never loses the trace.  Spec-agnostic: the KubeAPI path
+    builds envs with state_env, the generic frontend with
+    gen.oracle.state_env."""
     rows = []
     for i, env in enumerate(envs):
         env_next = envs[i + 1] if i + 1 < len(envs) else env
@@ -604,3 +604,12 @@ def eval_over_trace(
                                       True))
         rows.append(row)
     return rows
+
+
+def eval_over_trace(
+    exprs: List[TraceExpression],
+    trace: List[Tuple[State, Optional[str]]],
+    cfg: ModelConfig,
+) -> List[List[ExprResult]]:
+    """eval_over_envs over a KubeAPI-oracle trace."""
+    return eval_over_envs(exprs, [state_env(st, cfg) for st, _ in trace])

@@ -296,6 +296,40 @@ def test_cli_generic_spec(capsys):
     assert "No error has been found" in out
 
 
+def test_cli_generic_trace_expressions(tmp_path, capsys):
+    """-traceExpressions works on generic-spec counterexample traces."""
+    from jaxtlc.cli import main
+
+    with open(TLA) as f:
+        text = f.read()
+    text = text.replace(
+        "====",
+        "NeverObserves == \\A self \\in Controllers : observed[self] = 0\n"
+        "====",
+    )
+    d = tmp_path / "Model_1"
+    d.mkdir()
+    (d / "Reconciler.tla").write_text(text)
+    (d / "MC.cfg").write_text(
+        "CONSTANT Controllers = {c1, c2}\nCONSTANT MaxGen = 2\n"
+        "SPECIFICATION Spec\nINVARIANT TypeOK\nINVARIANT NeverObserves\n"
+    )
+    te = tmp_path / "te.txt"
+    te.write_text("D == desired\n"
+                  "Lag == \\E self \\in Controllers : "
+                  "observed[self] # desired\n")
+    rc = main(["check", str(d / "MC.cfg"), "-noTool", "-traceExpressions",
+               str(te), "-chunk", "64", "-qcap", "1024", "-fpcap", "4096"])
+    out = capsys.readouterr().out
+    assert rc == 12
+    import re
+
+    n_states = len(re.findall(r"^State \d+: ", out, re.M))
+    assert n_states > 0
+    assert out.count("/\\ D = ") == n_states
+    assert out.count("/\\ Lag = ") == n_states
+
+
 def test_cli_generic_invariant_violation(tmp_path, capsys):
     from jaxtlc.cli import main
 
