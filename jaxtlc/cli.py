@@ -395,7 +395,8 @@ def _run_check_gen(args, spec) -> int:
                 log.msg(2217, head + "\n" + text, severity=1)
     elif not liveness_violated:
         log.success(r.generated, r.distinct, None)
-        log.coverage_generic(spec.spec_name, 1, r.action_generated)
+        log.coverage_generic(spec.spec_name, 1, r.action_generated,
+                             r.action_distinct)
     log.progress(r.depth, r.generated, r.distinct, r.queue_left)
     log.final_counts(r.generated, r.distinct, r.queue_left)
     log.depth(r.depth)

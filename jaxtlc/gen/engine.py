@@ -49,6 +49,7 @@ class GenCarry(NamedTuple):
     generated: jnp.ndarray
     distinct: jnp.ndarray
     act_gen: jnp.ndarray  # [n_actions] uint32
+    act_dist: jnp.ndarray  # [n_actions] uint32 (new states per action)
     viol: jnp.ndarray
     viol_state: jnp.ndarray  # [F] int32
 
@@ -107,6 +108,7 @@ def make_gen_engine(
             generated=jnp.uint32(n0),
             distinct=is_new_c.sum().astype(jnp.uint32),
             act_gen=jnp.zeros(n_actions, jnp.uint32),
+            act_dist=jnp.zeros(n_actions, jnp.uint32),
             viol=viol,
             viol_state=viol_state,
         )
@@ -191,10 +193,24 @@ def make_gen_engine(
         queue, _ = lax.while_loop(enq_cond, enq_body, (c.queue, jnp.int32(0)))
 
         # per-action generated counts: static lane -> action compare-reduce
+        lane_onehot = (
+            lane_action[:, None] == jnp.arange(n_actions)[None, :]
+        )  # [L, n_actions]
         lane_counts = valid.sum(axis=0).astype(jnp.uint32)  # [L]
         act_gen = c.act_gen + (
-            (lane_action[:, None] == jnp.arange(n_actions)[None, :])
-            * lane_counts[:, None]
+            lane_onehot * lane_counts[:, None]
+        ).sum(axis=0).astype(jnp.uint32)
+
+        # per-action distinct counts: the compacted new entries' lanes are
+        # c_idx % L (same compare-reduce, no scatter)
+        new_lane = jnp.where(
+            jnp.arange(ncand) < n_new, e_idx.astype(jnp.int32) % L, -1
+        )
+        new_lane_counts = (
+            (new_lane[:, None] == jnp.arange(L)[None, :]).sum(axis=0)
+        ).astype(jnp.uint32)  # [L]
+        act_dist = c.act_dist + (
+            lane_onehot * new_lane_counts[:, None]
         ).sum(axis=0).astype(jnp.uint32)
 
         generated = c.generated + valid.sum().astype(jnp.uint32)
@@ -230,6 +246,7 @@ def make_gen_engine(
             fps=fps, queue=queue, parity=parity, qhead=qhead,
             level_n=level_n, next_n=next_n, level=level, depth=depth,
             generated=generated, distinct=distinct, act_gen=act_gen,
+            act_dist=act_dist,
             viol=viol, viol_state=viol_state,
         )
 
@@ -287,7 +304,10 @@ def check_gen(
             spec.actions[i].name: int(v)
             for i, v in enumerate(act_gen) if v
         },
-        action_distinct={},
+        action_distinct={
+            spec.actions[i].name: int(v)
+            for i, v in enumerate(np.asarray(out.act_dist)) if v
+        },
         wall_s=wall,
         iterations=-1,
     )

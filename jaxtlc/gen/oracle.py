@@ -30,6 +30,9 @@ class GenOracleResult(NamedTuple):
     parents: Optional[Dict[State, Tuple[Optional[State], Optional[str]]]] = (
         None
     )
+    # new-state credit per action; in-batch attribution order differs
+    # between engines, so cross-engine tests compare SUMS (= distinct-1)
+    action_distinct: Optional[Dict[str, int]] = None
 
 
 def state_env(spec: GenSpec, st: State) -> dict:
@@ -102,6 +105,7 @@ def bfs(spec: GenSpec, max_states: int = 5_000_000,
     depth = 1
     violations: List[Tuple[str, State]] = []
     act_gen: Dict[str, int] = {}
+    act_dist: Dict[str, int] = {}
     deadlocks: List[State] = []
     for name, ast in spec.invariants.items():
         if not texpr.evaluate(ast, state_env(spec, init)):
@@ -123,6 +127,7 @@ def bfs(spec: GenSpec, max_states: int = 5_000_000,
                 raise RuntimeError("state-space bound exceeded")
             seen[nxt] = seen[st] + 1
             depth = max(depth, seen[nxt] + 1)
+            act_dist[base] = act_dist.get(base, 0) + 1
             if keep_parents:
                 parents[nxt] = (st, label)
             for name, ast in spec.invariants.items():
@@ -139,6 +144,7 @@ def bfs(spec: GenSpec, max_states: int = 5_000_000,
         action_generated=act_gen,
         deadlocks=deadlocks,
         parents=parents,
+        action_distinct=act_dist,
     )
 
 

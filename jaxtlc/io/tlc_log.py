@@ -231,10 +231,13 @@ class TLCLog:
             )
 
     def coverage_generic(self, module: str, init_count: int,
-                         act_gen: Dict[str, int]) -> None:
+                         act_gen: Dict[str, int],
+                         act_dist: Dict[str, int] = None) -> None:
         """Per-action coverage for generic-frontend specs: the module's own
-        action names (no hardcoded span table; spans need the module's
-        source map, which the generic parser doesn't keep yet)."""
+        action names with TLC's distinct:generated counts (no hardcoded
+        span table; spans need the module's source map, which the generic
+        parser doesn't keep yet)."""
+        act_dist = act_dist or {}
         self.msg(
             2201,
             f"The coverage statistics at {time.strftime('%Y-%m-%d %H:%M:%S')}",
@@ -242,7 +245,8 @@ class TLCLog:
         self.msg(2773, f"<Init of module {module}>: "
                        f"{init_count}:{init_count}")
         for name, g in act_gen.items():
-            self.msg(2772, f"<{name} of module {module}>: {g}")
+            d = act_dist.get(name, 0)
+            self.msg(2772, f"<{name} of module {module}>: {d}:{g}")
 
     def final_counts(self, generated: int, distinct: int, queue: int) -> None:
         self.msg(

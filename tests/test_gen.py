@@ -106,6 +106,11 @@ def test_device_engine_parity(spec):
     assert (r.generated, r.distinct, r.depth) == EXPECT
     assert r.violation == 0 and r.queue_left == 0
     assert r.action_generated == o.action_generated
+    # per-action distinct: attribution of simultaneously-discovered
+    # states legitimately differs between engines; sums must agree and
+    # account for every non-initial state
+    assert sum(r.action_distinct.values()) == r.distinct - 1
+    assert sum(o.action_distinct.values()) == o.distinct - 1
 
 
 def test_invariant_violation_and_trace(tmp_path):
