@@ -201,16 +201,16 @@ def make_gen_engine(
             lane_onehot * lane_counts[:, None]
         ).sum(axis=0).astype(jnp.uint32)
 
-        # per-action distinct counts: the compacted new entries' lanes are
-        # c_idx % L (same compare-reduce, no scatter)
-        new_lane = jnp.where(
-            jnp.arange(ncand) < n_new, e_idx.astype(jnp.int32) % L, -1
+        # per-action distinct counts: map each new entry's lane straight
+        # to its action (tiny gather + [ncand, n_actions] compare-reduce,
+        # the bfs.py enq_body pattern - no [ncand, L] intermediate)
+        new_act = jnp.where(
+            jnp.arange(ncand) < n_new,
+            lane_action[e_idx.astype(jnp.int32) % L],
+            -1,
         )
-        new_lane_counts = (
-            (new_lane[:, None] == jnp.arange(L)[None, :]).sum(axis=0)
-        ).astype(jnp.uint32)  # [L]
         act_dist = c.act_dist + (
-            lane_onehot * new_lane_counts[:, None]
+            new_act[:, None] == jnp.arange(n_actions)[None, :]
         ).sum(axis=0).astype(jnp.uint32)
 
         generated = c.generated + valid.sum().astype(jnp.uint32)

@@ -232,12 +232,11 @@ class TLCLog:
 
     def coverage_generic(self, module: str, init_count: int,
                          act_gen: Dict[str, int],
-                         act_dist: Dict[str, int] = None) -> None:
+                         act_dist: Dict[str, int]) -> None:
         """Per-action coverage for generic-frontend specs: the module's own
         action names with TLC's distinct:generated counts (no hardcoded
         span table; spans need the module's source map, which the generic
         parser doesn't keep yet)."""
-        act_dist = act_dist or {}
         self.msg(
             2201,
             f"The coverage statistics at {time.strftime('%Y-%m-%d %H:%M:%S')}",
