@@ -209,7 +209,8 @@ _UNCHANGED_RE = re.compile(
 _ASSIGN_RE = re.compile(r"^(?P<var>[A-Za-z_]\w*)'\s*=\s*(?P<rhs>.+)$", re.S)
 _EXISTS_RE = re.compile(
     r"^\(\s*\\E\s+(?P<var>\w+)\s+\\in\s+(?P<dom>[^:]+):\s*"
-    r"(?P<call>[A-Za-z_]\w*)\s*\(\s*(?P=var)\s*\)\s*\)$"
+    r"(?P<body>.+)\)$",
+    re.S,
 )
 # nested two-parameter form: (\E i \in S : (\E j \in T : act(i, j)))
 _EXISTS2_RE = re.compile(
@@ -456,10 +457,30 @@ class ModuleParser:
             )
         em = _EXISTS_RE.match(disj)
         if em:
-            return self._expand_call(
-                em.group("call"), (em.group("var"),),
-                (self._exists_domain(em.group("dom")),),
-            )
+            # body: a call, or a (dis)junction group of calls over the
+            # bound variable - e.g. (\E e \in E : (Fail(e) \/ Recover(e)))
+            var = em.group("var")
+            values = (self._exists_domain(em.group("dom")),)
+            body = _strip_outer(em.group("body"))
+            out = []
+            for part in split_top(body, "\\/"):
+                part = _strip_outer(part)
+                cm = _CALL_RE.match(part)
+                if not cm or cm.group("name") not in self.defs:
+                    raise SpecParseError(
+                        f"unsupported \\E body disjunct: {part}"
+                    )
+                args = tuple(a for a in (cm.group("arg"), cm.group("arg2"))
+                             if a)
+                if args != (var,):
+                    raise SpecParseError(
+                        f"{cm.group('name')}{args}: \\E binds only "
+                        f"{var!r}"
+                    )
+                out.extend(
+                    self._expand_call(cm.group("name"), (var,), values)
+                )
+            return out
         if disj.startswith("(") and disj.endswith(")"):
             # parenthesized group: recurse on the inner disjunction
             inner = disj[1:-1].strip()

@@ -1,0 +1,95 @@
+---- MODULE ServiceMesh ----
+\* Istio-style sidecar routing (the fourth config family from
+\* BASELINE.json: "Service-mesh sidecar routing spec ... high-fanout
+\* Next relation").  Each client sidecar keeps a per-endpoint health
+\* view and routes every request to SOME endpoint it believes healthy -
+\* one Next branch per (sidecar, believed-healthy endpoint) pair, the
+\* high-fanout shape - while endpoints fail and recover underneath and
+\* timeouts feed the circuit-breaker view.
+\*
+\* Written in the jaxtlc generic-frontend subset (two-level function
+\* `view`, two-parameter actions).
+EXTENDS Naturals
+
+CONSTANTS Sidecars, Endpoints, MaxReqs
+
+VARIABLES up, view, inflight, done
+
+vars == << up, view, inflight, done >>
+
+TypeOK == /\ up \in [Endpoints -> BOOLEAN]
+          /\ view \in [Sidecars -> [Endpoints -> {"ok", "down"}]]
+          /\ inflight \in [Sidecars -> {"none"} \cup Endpoints]
+          /\ done \in [Sidecars -> 0..MaxReqs]
+
+Init == /\ up = [e \in Endpoints |-> TRUE]
+        /\ view = [s \in Sidecars |-> [e \in Endpoints |-> "ok"]]
+        /\ inflight = [s \in Sidecars |-> "none"]
+        /\ done = [s \in Sidecars |-> 0]
+
+\* The environment: endpoints crash and come back at any time.
+Fail(e) == /\ up[e]
+           /\ up' = [up EXCEPT ![e] = FALSE]
+           /\ UNCHANGED << view, inflight, done >>
+
+Recover(e) == /\ ~up[e]
+              /\ up' = [up EXCEPT ![e] = TRUE]
+              /\ UNCHANGED << view, inflight, done >>
+
+\* Route the next request to ANY endpoint the sidecar believes healthy
+\* (the fanout: a branch per believed-ok endpoint).
+Send(s, e) == /\ inflight[s] = "none"
+              /\ done[s] < MaxReqs
+              /\ view[s][e] = "ok"
+              /\ inflight' = [inflight EXCEPT ![s] = e]
+              /\ UNCHANGED << up, view, done >>
+
+\* The endpoint was actually up: the request completes.
+Succeed(s, e) == /\ inflight[s] = e
+                 /\ up[e]
+                 /\ done' = [done EXCEPT ![s] = @ + 1]
+                 /\ inflight' = [inflight EXCEPT ![s] = "none"]
+                 /\ UNCHANGED << up, view >>
+
+\* It was down: the request times out and the circuit breaker opens
+\* (the sidecar will retry elsewhere).
+Timeout(s, e) == /\ inflight[s] = e
+                 /\ ~up[e]
+                 /\ view' = [view EXCEPT ![s][e] = "down"]
+                 /\ inflight' = [inflight EXCEPT ![s] = "none"]
+                 /\ UNCHANGED << up, done >>
+
+\* An active health probe closes the breaker once the endpoint is back.
+Probe(s, e) == /\ view[s][e] = "down"
+               /\ up[e]
+               /\ view' = [view EXCEPT ![s][e] = "ok"]
+               /\ UNCHANGED << up, inflight, done >>
+
+\* All traffic delivered: stutter instead of a TLC deadlock.
+Terminating == /\ \A s \in Sidecars : done[s] = MaxReqs
+               /\ UNCHANGED vars
+
+Next == Terminating
+          \/ (\E e \in Endpoints : (Fail(e) \/ Recover(e)))
+          \/ (\E s \in Sidecars : (\E e \in Endpoints : Send(s, e)))
+          \/ (\E s \in Sidecars : (\E e \in Endpoints : Succeed(s, e)))
+          \/ (\E s \in Sidecars : (\E e \in Endpoints : Timeout(s, e)))
+          \/ (\E s \in Sidecars : (\E e \in Endpoints : Probe(s, e)))
+
+Spec == Init /\ [][Next]_vars /\ WF_vars(Next)
+
+\* A sidecar only keeps a request in flight toward an endpoint its view
+\* still trusts (Timeout atomically opens the breaker and clears the
+\* request; nothing else can open it while the request is in flight).
+InflightTrusted == \A s \in Sidecars : \A e \in Endpoints :
+    (inflight[s] = e) => (view[s][e] = "ok")
+
+DoneBounded == \A s \in Sidecars : done[s] <= MaxReqs
+
+\* GENUINELY VIOLATED under WF(Next): fail/recover flapping (or a
+\* permanently dead endpoint set) can starve a sidecar forever - the
+\* checker reports the lasso.  Raft-style: the admissible environment is
+\* allowed to be this hostile.
+EventuallyDelivered ==
+    (done["s1"] = 0) ~> (done["s1"] = MaxReqs)
+====

@@ -1,0 +1,104 @@
+"""Service-mesh sidecar-routing spec tests (the fourth BASELINE.json
+config family): high-fanout Next (a Send branch per believed-healthy
+endpoint per sidecar), circuit-breaker views as a two-level function,
+environment fail/recover flapping - oracle pins, device parity, the
+trusted-inflight invariant, and the honestly-violated delivery property."""
+
+import os
+
+import pytest
+
+SPEC_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "specs", "ServiceMesh.toolbox", "Model_1",
+)
+TLA = os.path.join(SPEC_DIR, "ServiceMesh.tla")
+CFG = os.path.join(SPEC_DIR, "MC.cfg")
+
+# oracle-pinned counts for 2 sidecars x 2 endpoints, MaxReqs=2
+EXPECT = (6421, 1444, 17)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    from jaxtlc.frontend.mc_cfg import parse_cfg_file
+    from jaxtlc.gen.tla_parse import load_genspec
+
+    cfg = parse_cfg_file(CFG)
+    return load_genspec(TLA, cfg.constants, cfg.invariants, cfg.properties)
+
+
+def test_parse_structure(spec):
+    names = [a.name for a in spec.actions]
+    assert names == ["Terminating", "Fail", "Recover", "Send", "Succeed",
+                     "Timeout", "Probe"]
+    send = spec.actions[3]
+    assert send.params == ("s", "e")
+    assert len(send.bindings()) == 4
+    v = spec.var("view")
+    assert v.index_set == ("s1", "s2") and v.index_set2 == ("e1", "e2")
+
+
+def test_oracle_and_device_parity(spec):
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.gen.engine import check_gen
+
+    o = go.bfs(spec)
+    assert (o.generated, o.distinct, o.depth) == EXPECT
+    assert not o.violations
+    r = check_gen(spec, chunk=256, queue_capacity=1 << 12,
+                  fp_capacity=1 << 14)
+    assert (r.generated, r.distinct, r.depth) == EXPECT
+    assert r.violation == 0 and r.queue_left == 0
+    assert r.action_generated == o.action_generated
+    assert sum(r.action_distinct.values()) == r.distinct - 1
+
+
+def test_breaker_race_is_caught(tmp_path):
+    """Remove the circuit breaker's atomic inflight clear (Timeout keeps
+    the request in flight) and the InflightTrusted invariant must fire."""
+    from jaxtlc.frontend.mc_cfg import parse_cfg_file
+    from jaxtlc.gen.engine import check_gen
+    from jaxtlc.gen.tla_parse import load_genspec
+
+    with open(TLA) as f:
+        original = f.read()
+    text = original.replace(
+        '                 /\\ view\' = [view EXCEPT ![s][e] = "down"]\n'
+        '                 /\\ inflight\' = [inflight EXCEPT ![s] = "none"]\n'
+        "                 /\\ UNCHANGED << up, done >>",
+        '                 /\\ view\' = [view EXCEPT ![s][e] = "down"]\n'
+        "                 /\\ UNCHANGED << up, inflight, done >>",
+    )
+    assert text != original  # the mutation really applied
+    p = tmp_path / "ServiceMesh.tla"
+    p.write_text(text)
+    cfg = parse_cfg_file(CFG)
+    spec = load_genspec(str(p), cfg.constants,
+                        ["TypeOK", "InflightTrusted"], [])
+    r = check_gen(spec, chunk=256, queue_capacity=1 << 12,
+                  fp_capacity=1 << 14)
+    assert r.violation >= 100
+    assert "InflightTrusted" in r.violation_name
+
+
+def test_flapping_starves_delivery(spec):
+    from jaxtlc.gen import oracle as go
+    from jaxtlc.spec import texpr
+
+    (name, (p, q)), = spec.properties.items()
+    res = go.check_leads_to(spec, p, q, name)
+    assert not res.holds  # fail/recover flapping can starve a sidecar
+    for st in res.lasso_cycle:
+        assert not texpr.evaluate(q, go.state_env(spec, st))
+
+
+def test_cli_servicemesh(capsys):
+    from jaxtlc.cli import main
+
+    rc = main(["check", CFG, "-noTool", "-chunk", "256", "-qcap", "4096",
+               "-fpcap", "16384"])
+    out = capsys.readouterr().out
+    assert rc == 13  # safety clean, delivery property violated
+    assert "6421 states generated, 1444 distinct states found" in out
+    assert "Temporal properties were violated: EventuallyDelivered" in out
