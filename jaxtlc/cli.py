@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import sys
 import time
 
@@ -210,7 +211,8 @@ def _run_check(args) -> int:
         else:
             log.msg(1000, f"Run stopped: {r.violation_name}", severity=1)
         _print_trace(log, spec.model, args.chunk,
-                     trace_expr_file=args.traceExpressions)
+                     trace_expr_file=args.traceExpressions,
+                     check_deadlock=spec.check_deadlock)
     elif not liveness_violated:
         log.success(r.generated, r.distinct,
                     getattr(r, "actual_fp_collision", None))
@@ -354,7 +356,7 @@ def _run_check_gen(args, spec) -> int:
     if violated:
         log.msg(2110 if r.violation >= 100 else 1000,
                 r.violation_name, severity=1)
-        found = violation_trace(g)
+        found = violation_trace(g, check_deadlock=spec.check_deadlock)
         if found is None:
             log.msg(1000, "Violation was not reproducible in host mode",
                     severity=1)
@@ -407,11 +409,13 @@ def _run_check_gen(args, spec) -> int:
 
 
 def _print_trace(log: TLCLog, model: ModelConfig, chunk: int,
-                 trace_expr_file: str = "") -> None:
+                 trace_expr_file: str = "",
+                 check_deadlock: bool = True) -> None:
     from .engine.trace import find_violation_trace
     from .spec.pretty import state_to_tla
 
-    found = find_violation_trace(model, chunk=chunk)
+    found = find_violation_trace(model, chunk=chunk,
+                                 check_deadlock=check_deadlock)
     if found is None:
         log.msg(1000, "Violation was not reproducible in host mode", severity=1)
         return
@@ -494,9 +498,27 @@ def main(argv=None) -> int:
                         "transition rule (e.g. delete_noop) to exercise "
                         "violation detection + trace reconstruction")
     args = p.parse_args(argv)
+    _select_platform(args.workers)
     if args.cmd == "check":
         return _run_check(args)
     return 1
+
+
+def _select_platform(workers: str) -> None:
+    """Apply the platform choice via jax.config BEFORE backend init.
+
+    In the tunnel environment the JAX_PLATFORMS env var is applied too
+    late (the baked sitecustomize registers the tunnel PJRT plugin at
+    interpreter start), and with the tunnel down even `JAX_PLATFORMS=cpu`
+    then hangs inside PJRT init; updating jax.config before the first
+    device query is the reliable escape.  `-workers cpu` or a cpu env
+    request both take this path; anything else keeps the default
+    (device) platform, matching TLC's `-workers` being a plain knob.
+    """
+    if workers == "cpu" or os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
 
 if __name__ == "__main__":

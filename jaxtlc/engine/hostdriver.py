@@ -44,6 +44,7 @@ def host_bfs(
     on_level: Optional[Callable] = None,
     keep_parents: bool = False,
     stop_on_violation: bool = True,
+    check_deadlock: bool = True,
 ) -> HostBFSResult:
     cdc = get_codec(cfg)
     kern = batched_kernel(cfg)
@@ -122,7 +123,10 @@ def host_bfs(
                 outdeg = len(succ_set)
                 max_out = max(max_out, outdeg)
                 min_out = min(min_out, outdeg)
-                if outdeg == 0:
+                if outdeg == 0 and check_deadlock:
+                    # must mirror the device run's -nodeadlock setting, or
+                    # an invariant violation could be "reproduced" here as
+                    # a deadlock at an earlier successor-less state
                     violations.append(("deadlock", src_t))
         if violations and stop_on_violation:
             break

@@ -37,6 +37,7 @@ scatter arbitration.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import shutil
@@ -234,7 +235,13 @@ def check_hybrid(
             0 if resume_meta is None else int(resume_meta["q_tail"])
         )
         if ckpt_path and resume_meta is None:
+            # a fresh run must clear the WHOLE stale snapshot set, meta
+            # FIRST: once no meta exists, -recover reports "no checkpoint"
+            # cleanly no matter where a crash lands in this cleanup
+            _rm(f"{ckpt_path}.meta.json")
             _rm(f"{ckpt_path}.sq.snap")
+            for stale in glob.glob(f"{glob.escape(ckpt_path)}.g*.fps*"):
+                _rm(stale)
 
         def checkpoint():
             # generation-numbered fp snapshots + an incremental queue

@@ -49,12 +49,14 @@ def decode_trace(cfg: ModelConfig, chain):
     ]
 
 
-def find_violation_trace(cfg: ModelConfig, chunk: int = 512):
+def find_violation_trace(cfg: ModelConfig, chunk: int = 512,
+                         check_deadlock: bool = True):
     """Re-run in host mode, stop at the first violation, return
     (kind, [(state, action), ...]) or None if the model is clean."""
     from .hostdriver import host_bfs
 
-    r = host_bfs(cfg, chunk=chunk, keep_parents=True, stop_on_violation=True)
+    r = host_bfs(cfg, chunk=chunk, keep_parents=True, stop_on_violation=True,
+                 check_deadlock=check_deadlock)
     if not r.violations:
         return None
     kind, enc = r.violations[0]

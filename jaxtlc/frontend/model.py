@@ -94,11 +94,30 @@ def resolve(
     if spec_name in ("", "KubeAPI") and not extends and not os.path.exists(
         mc_tla_path
     ):
-        # no MC.tla: the cfg may sit next to a bare root module
-        for f in sorted(os.listdir(model_dir)):
-            if f.endswith(".tla"):
-                spec_name = f[:-4]
-                break
+        # no MC.tla: the cfg may sit next to a bare root module; prefer
+        # TLC's Foo.cfg <-> Foo.tla convention, then a module named like
+        # the toolbox dir ("Foo.toolbox" -> Foo.tla), and refuse to guess
+        # among several unrelated candidates (the alphabetically-first
+        # pick could silently grab a helper module)
+        cands = sorted(
+            f[:-4] for f in os.listdir(model_dir) if f.endswith(".tla")
+        )
+        cfg_base = os.path.splitext(os.path.basename(cfg_path))[0]
+        toolbox = os.path.basename(os.path.dirname(model_dir))
+        toolbox = toolbox[:-8] if toolbox.endswith(".toolbox") else toolbox
+        preferred = [p for p in (cfg_base, toolbox) if p in cands]
+        if preferred:
+            spec_name = preferred[0]
+        elif len(cands) == 1:
+            spec_name = cands[0]
+        elif cands:
+            raise ValueError(
+                f"ambiguous root spec: several .tla modules next to the "
+                f"config ({', '.join(cands)}) and none matches the config "
+                f"name {cfg_base!r} or toolbox name {toolbox!r}; add a "
+                ".launch file or an "
+                "MC.tla naming the root module"
+            )
     if spec_name not in ("", "KubeAPI"):
         # generic frontend (E1): execute any PlusCal-translation-subset
         # module found next to the config

@@ -148,10 +148,16 @@ def bfs(spec: GenSpec, max_states: int = 5_000_000,
     )
 
 
-def violation_trace(spec: GenSpec, max_states: int = 5_000_000):
+def violation_trace(spec: GenSpec, max_states: int = 5_000_000,
+                    check_deadlock: bool = True):
     """Host re-run -> (kind, [(state, action_label or None), ...]) for the
-    first violation, or None if clean (the generic trace-explorer path)."""
-    r = bfs(spec, max_states=max_states, keep_parents=True)
+    first violation, or None if clean (the generic trace-explorer path).
+
+    check_deadlock must match the device run's setting: with it forced on,
+    an invariant violation found on device could be "reproduced" here as a
+    Deadlock at an earlier successor-less state - a wrong-kind trace."""
+    r = bfs(spec, max_states=max_states, keep_parents=True,
+            check_deadlock=check_deadlock)
     if not r.violations:
         return None
     kind, bad = r.violations[0]

@@ -137,7 +137,6 @@ class TLCLog:
         self.msg(2219, "SANY finished.")
 
     def starting(self) -> None:
-        self._t0 = time.time()
         self.msg(2185, f"Starting... ({time.strftime('%Y-%m-%d %H:%M:%S')})")
 
     def computing_init(self) -> None:
@@ -165,15 +164,10 @@ class TLCLog:
             dpm = int((distinct - prev[2]) * 60 / dt)
             self._last_rates = (spm, dpm)
         else:
-            # first report: rates since the start (TLC does the same)
-            t0 = getattr(self, "_t0", None)
-            if t0 is None or now <= t0:
-                self._last_rates = (generated * 60, distinct * 60)
-            else:
-                self._last_rates = (
-                    int(generated * 60 / (now - t0)),
-                    int(distinct * 60 / (now - t0)),
-                )
+            # first report: TLC prints the raw interval counts as the
+            # "per-minute" rates (MC.out:35 shows 538,163 generated in ~4 s
+            # reported as "538,163 s/min"), so we do the same
+            self._last_rates = (generated, distinct)
         spm, dpm = self._last_rates
         self.msg(
             2200,
