@@ -1,0 +1,15 @@
+"""Structural TLA+ frontend (E1): parse and execute real TLA+ modules.
+
+Unlike jaxtlc.gen (the finite-domain subset compiler), this package
+parses the reference's own module text - including the committed PlusCal
+translation in /root/reference/KubeAPI.tla:373-768 - into ASTs and
+executes the transition relation directly:
+
+* parser:  full-module tokenizer + junction-list expression grammar
+* eval:    TLA+ value semantics over the oracle's canonical value model
+* actions: next-state enumeration (the constraint-program reading of a
+           translation action)
+* oracle:  BFS model checker over the interpreted relation
+* shapes:  finite-universe inference for device compilation
+* compile: AST -> lane kernel for the fused device engine
+"""

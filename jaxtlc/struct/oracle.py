@@ -1,0 +1,143 @@
+"""BFS model checker over the structurally-interpreted relation (E1).
+
+Same accounting as TLC and the hand oracle (spec.oracle.bfs): initial
+states count toward generated and distinct (MC.out:29-32); every
+enumerated successor counts as generated; depth = BFS levels with Init
+at level 1 (MC.out:1101); deadlock = a state with no successor at all
+(self-loops count as successors); invariants are checked on every
+distinct state.  Action attribution uses the PlusCal label names
+(MC.out:44-1092), so per-action generated counts diff directly against
+the hand oracle and the TLC log.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from .actions import ActionSystem
+from .eval import StructEvalError, TlaAssertionError
+
+
+class StructBFSResult(NamedTuple):
+    generated: int
+    distinct: int
+    depth: int
+    max_outdegree: int
+    min_outdegree: int
+    violations: List[Tuple[str, tuple]]
+    action_generated: Dict[str, int]
+    action_distinct: Dict[str, int]
+    levels: List[int]
+    parents: Optional[Dict[tuple, Tuple[Optional[tuple], Optional[str]]]]
+    states: Optional[Dict[tuple, int]]  # state -> level (collect_states)
+
+
+def bfs(
+    system: ActionSystem,
+    invariants: Dict[str, tuple],
+    check_deadlock: bool = True,
+    max_states: int = 10_000_000,
+    keep_parents: bool = False,
+    stop_on_violation: bool = True,
+    collect_states: bool = False,
+) -> StructBFSResult:
+    ev = system.ev
+    inits = system.initial_states()
+    seen: Dict[tuple, int] = {}
+    parents: Optional[Dict] = {} if keep_parents else None
+    generated = 0
+    violations: List[Tuple[str, tuple]] = []
+    frontier: List[tuple] = []
+    act_gen: Dict[str, int] = {}
+    act_dist: Dict[str, int] = {}
+
+    def check_invs(st: tuple):
+        env = dict(ev.constants)
+        env.update(zip(system.variables, st))
+        for name, ast in invariants.items():
+            if ev.eval(ast, env) is not True:
+                violations.append((name, st))
+
+    for s in inits:
+        generated += 1
+        if s not in seen:
+            seen[s] = 1
+            frontier.append(s)
+            if keep_parents:
+                parents[s] = (None, None)
+            check_invs(s)
+    depth = 1
+    levels = [len(frontier)]
+    max_out, min_out = 0, 1 << 30
+    while frontier and not (violations and stop_on_violation):
+        nxt: List[tuple] = []
+        for s in frontier:
+            try:
+                succs = system.successors(s)
+            except TlaAssertionError as e:
+                violations.append((f"assert:{e.tla_msg}", s))
+                if stop_on_violation:
+                    break
+                continue
+            generated += len(succs)
+            distinct_succs = {t for _, t in succs}
+            outdeg = len(distinct_succs)
+            max_out = max(max_out, outdeg)
+            min_out = min(min_out, outdeg)
+            if outdeg == 0 and check_deadlock:
+                violations.append(("deadlock", s))
+            for label, t in succs:
+                act_gen[label] = act_gen.get(label, 0) + 1
+                if t not in seen:
+                    if len(seen) >= max_states:
+                        raise RuntimeError("state-space bound exceeded")
+                    seen[t] = depth + 1
+                    nxt.append(t)
+                    act_dist[label] = act_dist.get(label, 0) + 1
+                    if keep_parents:
+                        parents[t] = (s, label)
+                    check_invs(t)
+        frontier = nxt
+        if frontier:
+            depth += 1
+            levels.append(len(frontier))
+    return StructBFSResult(
+        generated=generated,
+        distinct=len(seen),
+        depth=depth,
+        max_outdegree=max_out,
+        min_outdegree=min_out if min_out != 1 << 30 else 0,
+        violations=violations,
+        action_generated=act_gen,
+        action_distinct=act_dist,
+        levels=levels,
+        parents=parents,
+        states=seen if collect_states else None,
+    )
+
+
+def state_env(system: ActionSystem, st: tuple) -> dict:
+    env = dict(system.ev.constants)
+    env.update(zip(system.variables, st))
+    return env
+
+
+def violation_trace(system: ActionSystem, invariants: Dict[str, tuple],
+                    check_deadlock: bool = True,
+                    max_states: int = 10_000_000):
+    """(kind, [(state, label|None), ...]) for the first violation, or
+    None - the trace-explorer re-run over the structural relation."""
+    r = bfs(system, invariants, check_deadlock=check_deadlock,
+            max_states=max_states, keep_parents=True)
+    if not r.violations:
+        return None
+    kind, bad = r.violations[0]
+    chain = []
+    cur: Optional[tuple] = bad
+    while cur is not None:
+        parent, label = r.parents[cur]
+        chain.append((cur, label))
+        cur = parent
+    chain.reverse()
+    return kind, chain

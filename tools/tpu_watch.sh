@@ -1,0 +1,19 @@
+#!/bin/bash
+# Watch for the axon relay (127.0.0.1:8083) to come back; append one
+# timestamp per down->up TRANSITION so a consumer sees each comeback
+# exactly once.  The relay is a launcher-side stdio pump (see memory:
+# axon-relay-jax-cpu-pattern); it cannot be restarted from inside the
+# container, only observed.
+MARKER=/tmp/tpu_back.marker
+up=0
+while true; do
+  if timeout 3 bash -c '</dev/tcp/127.0.0.1/8083' 2>/dev/null; then
+    if [ "$up" = 0 ]; then
+      date -u +%FT%TZ >> "$MARKER"
+      up=1
+    fi
+  else
+    up=0
+  fi
+  sleep 60
+done
