@@ -39,14 +39,17 @@ def _run_check(args) -> int:
             workers=args.workers,
             fp_index=args.fp,
             check_deadlock=not args.nodeadlock,
+            frontend=args.frontend,
         )
     except (ValueError, OSError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
-    from .frontend.model import GenRunSpec
+    from .frontend.model import GenRunSpec, StructRunSpec
 
     if isinstance(spec, GenRunSpec):
         return _run_check_gen(args, spec)
+    if isinstance(spec, StructRunSpec):
+        return _run_check_struct(args, spec)
     from .frontend.model import KNOWN_PROPERTIES
 
     unknown = [q for q in spec.properties if q not in KNOWN_PROPERTIES]
@@ -287,11 +290,118 @@ def _sany_inputs(cfg_path: str, spec_name: str):
 
 
 def _run_check_gen(args, spec) -> int:
-    """Check a generic-frontend spec (E1): device engine + host liveness.
+    """Check a generic-frontend spec (E1): device engine + host liveness."""
+    from .gen import oracle as go
+    from .gen.engine import check_gen
 
-    Same TLC log protocol and exit conventions as the KubeAPI path; the
-    -sharded/-checkpoint/-fpset engine variants are KubeAPI-engine knobs
-    and are rejected here (the generic engine is single-device fused)."""
+    g = spec.genspec
+
+    def props():
+        for name, (p_ast, q_ast) in g.properties.items():
+            yield name, p_ast, q_ast, None
+
+    kit = _InterpKit(
+        kind="generic",
+        extra_unsupported=(),
+        check=lambda: check_gen(
+            g,
+            chunk=args.chunk,
+            queue_capacity=args.qcap,
+            fp_capacity=args.fpcap,
+            fp_index=spec.fp_index,
+            check_deadlock=spec.check_deadlock,
+        ),
+        init_count=lambda: 1,
+        properties=props,
+        check_leads_to=lambda name, p, q: go.check_leads_to(
+            g, p, q, name, fairness=args.fairness
+        ),
+        fairness_label=args.fairness,
+        state_to_tla=lambda st: go.state_to_tla(g, st),
+        state_env=lambda st: go.state_env(g, st),
+        violation_trace=lambda: go.violation_trace(
+            g, check_deadlock=spec.check_deadlock
+        ),
+    )
+    return _run_check_interp(args, spec, kit)
+
+
+def _run_check_struct(args, spec) -> int:
+    """Check a structural-frontend spec (E1): the full-module path that
+    runs specs outside the gen subset - the reference's own KubeAPI.tla
+    included.  Device engine for safety, host graph for liveness, host
+    re-run for traces; same log protocol and exit conventions."""
+    from .struct import oracle as so
+    from .struct.engine import check_struct
+
+    sm = spec.structmodel
+    system = sm.system
+
+    def props():
+        for name in spec.properties:
+            ast = sm.properties[name]
+            if ast[0] != "leadsto" or ast[1][0] == "box":
+                yield name, None, None, (
+                    "only plain P ~> Q is checked on the structural path"
+                )
+                continue
+            yield name, ast[1], ast[2], None
+
+    kit = _InterpKit(
+        kind="structural",
+        # the structural liveness graph is wf_next-only so far
+        extra_unsupported=(
+            ("-fairness wf_process", args.fairness == "wf_process"),
+        ),
+        check=lambda: check_struct(
+            sm,
+            chunk=args.chunk,
+            queue_capacity=args.qcap,
+            fp_capacity=args.fpcap,
+            fp_index=spec.fp_index,
+            check_deadlock=spec.check_deadlock,
+        ),
+        # lazy: Init enumeration is real work on struct specs and must
+        # not run when the flags are about to be rejected
+        init_count=lambda: len(system.initial_states()),
+        properties=props,
+        check_leads_to=lambda name, p, q: so.check_leads_to(
+            system, p, q, name
+        ),
+        fairness_label="wf_next",
+        state_to_tla=lambda st: so.state_to_tla(system, st),
+        state_env=lambda st: so.state_env(system, st),
+        violation_trace=lambda: so.violation_trace(
+            system, sm.invariants, check_deadlock=spec.check_deadlock
+        ),
+    )
+    return _run_check_interp(args, spec, kit)
+
+
+class _InterpKit:
+    """Everything the shared interpreted-spec runner needs from a
+    frontend: one object so the gen/struct runners cannot drift."""
+
+    def __init__(self, kind, extra_unsupported, check, init_count,
+                 properties, check_leads_to, fairness_label,
+                 state_to_tla, state_env, violation_trace):
+        self.kind = kind
+        self.extra_unsupported = extra_unsupported
+        self.check = check
+        self.init_count = init_count
+        self.properties = properties
+        self.check_leads_to = check_leads_to
+        self.fairness_label = fairness_label
+        self.state_to_tla = state_to_tla
+        self.state_env = state_env
+        self.violation_trace = violation_trace
+
+
+def _run_check_interp(args, spec, kit: "_InterpKit") -> int:
+    """Shared runner for the interpreted frontends (gen + struct): the
+    KubeAPI-engine knobs are rejected, the device engine checks safety,
+    the host graph checks liveness, and violations re-run on the host
+    interpreter for the trace.  TLC log protocol + exit conventions."""
     unsupported = [
         flag for flag, on in (
             ("-sharded", args.sharded),
@@ -300,22 +410,19 @@ def _run_check_gen(args, spec) -> int:
             ("-fpset DiskFPSet", args.fpset != "JaxFPSet"),
             ("-mutation", args.mutation),
             ("-coverage", args.coverage),
+            *kit.extra_unsupported,
         ) if on
     ]
     if unsupported:
         print(
             f"Error: {', '.join(unsupported)} not supported for "
-            "generic-frontend specs yet (KubeAPI-engine knobs)",
+            f"{kit.kind}-frontend specs yet",
             file=sys.stderr,
         )
         return 1
     log = TLCLog(tool_mode=not args.noTool)
     import jax
 
-    from .gen.engine import check_gen
-    from .gen.oracle import check_leads_to, state_to_tla, violation_trace
-
-    g = spec.genspec
     device = str(jax.devices()[0])
     log.version(__version__)
     log.banner(spec.fp_index, DEFAULT_SEED, spec.workers, device)
@@ -323,48 +430,45 @@ def _run_check_gen(args, spec) -> int:
     log.starting()
     log.computing_init()
     t0 = time.time()
-    r = check_gen(
-        g,
-        chunk=args.chunk,
-        queue_capacity=args.qcap,
-        fp_capacity=args.fpcap,
-        fp_index=spec.fp_index,
-        check_deadlock=spec.check_deadlock,
-    )
-    log.init_done(1)
+    r = kit.check()
+    n_init = kit.init_count()
+    log.init_done(n_init)
     violated = r.violation != 0
     liveness_violated = False
     if not violated and spec.properties:
-        for name, (p_ast, q_ast) in g.properties.items():
-            res = check_leads_to(g, p_ast, q_ast, name)
+        for name, p_ast, q_ast, skip in kit.properties():
+            if skip is not None:
+                log.msg(1000, f"Temporal property {name} skipped: "
+                              f"{skip}.", severity=1)
+                continue
+            res = kit.check_leads_to(name, p_ast, q_ast)
             if res.holds:
                 log.msg(1000, f"Temporal property {name} holds "
-                              "(fairness: wf_next).")
+                              f"(fairness: {kit.fairness_label}).")
                 continue
             liveness_violated = True
             log.msg(2116, f"Temporal properties were violated: {name}",
                     severity=1)
             idx = 1
             for st in res.lasso_prefix:
-                log.trace_state(idx, None, state_to_tla(g, st))
+                log.trace_state(idx, None, kit.state_to_tla(st))
                 idx += 1
             log.msg(1000, "-- The following states form a cycle "
                           "(back to the first of them) --")
             for st in res.lasso_cycle:
-                log.trace_state(idx, None, state_to_tla(g, st))
+                log.trace_state(idx, None, kit.state_to_tla(st))
                 idx += 1
     if violated:
         log.msg(2110 if r.violation >= 100 else 1000,
                 r.violation_name, severity=1)
-        found = violation_trace(g, check_deadlock=spec.check_deadlock)
+        found = kit.violation_trace()
         if found is None:
             log.msg(1000, "Violation was not reproducible in host mode",
                     severity=1)
         else:
             expr_rows = None
             if args.traceExpressions:
-                # trace-explorer re-evaluation over generic-spec states
-                from .gen.oracle import state_env as gen_state_env
+                # trace-explorer re-evaluation over interpreted states
                 from .spec.texpr import (
                     TexprError,
                     eval_over_envs,
@@ -376,7 +480,7 @@ def _run_check_gen(args, spec) -> int:
                         exprs = parse_expressions(f.read())
                     expr_rows = eval_over_envs(
                         exprs,
-                        [gen_state_env(g, st) for st, _ in found[1]],
+                        [kit.state_env(st) for st, _ in found[1]],
                     )
                 except (OSError, TexprError) as e:
                     log.msg(1000, f"Trace expressions skipped: {e}",
@@ -384,21 +488,21 @@ def _run_check_gen(args, spec) -> int:
             for i, (st, act) in enumerate(found[1], start=1):
                 head = (f"State {i}: <Initial predicate>" if act is None
                         else f"State {i}: <{act}>")
-                text = state_to_tla(g, st)
+                text = kit.state_to_tla(st)
                 if expr_rows is not None:
                     from .spec.pretty import value_to_tla
 
                     text += "".join(
                         f"\n/\\ {res.name} = "
-                        + (f"<evaluation failed: {res.value}>" if res.failed
-                           else value_to_tla(res.value))
+                        + (f"<evaluation failed: {res.value}>"
+                           if res.failed else value_to_tla(res.value))
                         for res in expr_rows[i - 1]
                     )
                 log.msg(2217, head + "\n" + text, severity=1)
     elif not liveness_violated:
         log.success(r.generated, r.distinct, None)
-        log.coverage_generic(spec.spec_name, 1, r.action_generated,
-                             r.action_distinct)
+        log.coverage_generic(spec.spec_name, n_init,
+                             r.action_generated, r.action_distinct)
     log.progress(r.depth, r.generated, r.distinct, r.queue_left)
     log.final_counts(r.generated, r.distinct, r.queue_left)
     log.depth(r.depth)
@@ -452,6 +556,12 @@ def main(argv=None) -> int:
     c = sub.add_parser("check", help="exhaustively check a TLC model config")
     c.add_argument("config", help="path to MC.cfg (sibling MC.tla is read)")
     c.add_argument("-workers", default="tpu", help="TLC contract knob")
+    c.add_argument("-frontend", default="auto",
+                   choices=["auto", "hand", "gen", "struct"],
+                   help="spec frontend: auto picks hand-tuned KubeAPI / "
+                        "gen-subset / structural as applicable; struct "
+                        "forces the full-module structural path (runs "
+                        "ANY spec, KubeAPI included)")
     c.add_argument("-fpset", default="JaxFPSet",
                    choices=["JaxFPSet", "DiskFPSet"],
                    help="JaxFPSet = device-resident fingerprint table; "

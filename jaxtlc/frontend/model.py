@@ -60,14 +60,39 @@ class GenRunSpec:
     model_name: str
 
 
+@dataclasses.dataclass
+class StructRunSpec:
+    """A resolved run for the structural frontend: the full-module path
+    (records, sets of records, procedure stacks, CHOOSE) that executes
+    specs outside the gen subset - the reference's own KubeAPI.tla
+    included (-frontend struct)."""
+
+    structmodel: object  # struct.loader.StructModel
+    invariants: List[str]
+    properties: List[str]
+    check_deadlock: bool
+    workers: str
+    fp_index: int
+    spec_name: str
+    model_name: str
+
+
 def resolve(
     cfg_path: str,
     launch_path: Optional[str] = None,
     workers: str = "tpu",
     fp_index: Optional[int] = None,
     check_deadlock: bool = True,
+    frontend: str = "auto",
 ) -> RunSpec:
-    """Resolve a run from an MC.cfg (with sibling MC.tla) like TLC would."""
+    """Resolve a run from an MC.cfg (with sibling MC.tla) like TLC would.
+
+    frontend: "auto" picks the hand-tuned KubeAPI path for the KubeAPI
+    root spec, the gen-subset compiler for subset specs, and falls back
+    to the structural frontend for anything else; "hand"/"gen"/"struct"
+    force a path (struct runs ANY spec, KubeAPI included)."""
+    if frontend not in ("auto", "hand", "gen", "struct"):
+        raise ValueError(f"unknown -frontend {frontend!r}")
     cfg: TLCConfig = parse_cfg_file(cfg_path)
     model_dir = os.path.dirname(os.path.abspath(cfg_path))
     mc_tla_path = os.path.join(model_dir, "MC.tla")
@@ -118,9 +143,26 @@ def resolve(
                 ".launch file or an "
                 "MC.tla naming the root module"
             )
-    if spec_name not in ("", "KubeAPI"):
+    if frontend == "struct" or (
+        frontend == "auto" and spec_name not in ("", "KubeAPI")
+        and not os.path.exists(
+            os.path.join(model_dir, f"{spec_name}.tla"))
+        and os.path.exists(mc_tla_path)
+    ):
+        # forced structural path, or a non-KubeAPI MC whose root module
+        # resolves through EXTENDS rather than a sibling file
+        return _resolve_struct(cfg_path, cfg, launch, spec_name,
+                               check_deadlock, workers, fp_index,
+                               model_dir)
+    if frontend == "hand" and spec_name not in ("", "KubeAPI"):
+        raise ValueError(
+            f"-frontend hand supports only the KubeAPI root spec, "
+            f"not {spec_name!r}"
+        )
+    if spec_name not in ("", "KubeAPI") or frontend == "gen":
         # generic frontend (E1): execute any PlusCal-translation-subset
-        # module found next to the config
+        # module found next to the config; outside-subset specs fall
+        # back to the structural frontend (full expression language)
         tla_path = os.path.join(model_dir, f"{spec_name}.tla")
         if not os.path.exists(tla_path):
             raise ValueError(
@@ -134,10 +176,14 @@ def resolve(
                 tla_path, consts, list(cfg.invariants), list(cfg.properties)
             )
         except SpecParseError as e:
-            raise ValueError(
-                f"root spec {spec_name!r} is outside the supported "
-                f"PlusCal-translation subset: {e}"
-            )
+            if frontend == "gen":
+                raise ValueError(
+                    f"root spec {spec_name!r} is outside the supported "
+                    f"PlusCal-translation subset: {e}"
+                )
+            return _resolve_struct(cfg_path, cfg, launch, spec_name,
+                                   check_deadlock, workers, fp_index,
+                                   model_dir)
         if launch:
             # launch-file knobs apply to generic specs exactly as to the
             # KubeAPI path (deadlock switch, fpIndex)
@@ -194,4 +240,32 @@ def resolve(
         fp_index=DEFAULT_FP_INDEX if fp_index is None else fp_index,
         spec_name=spec_name or "KubeAPI",
         model_name=(launch.model_name if launch else os.path.basename(model_dir)),
+    )
+
+
+def _resolve_struct(cfg_path, cfg, launch, spec_name, check_deadlock,
+                    workers, fp_index, model_dir) -> StructRunSpec:
+    from ..struct.loader import StructLoadError, load as load_struct
+    from ..struct.parser import StructParseError
+
+    try:
+        sm = load_struct(cfg_path)
+    except (StructLoadError, StructParseError) as e:
+        raise ValueError(
+            f"root spec {spec_name!r}: structural frontend cannot load "
+            f"the module: {e}"
+        )
+    if launch:
+        check_deadlock = launch.check_deadlock
+        if fp_index is None:
+            fp_index = launch.fp_index
+    return StructRunSpec(
+        structmodel=sm,
+        invariants=list(cfg.invariants),
+        properties=list(cfg.properties),
+        check_deadlock=check_deadlock,
+        workers=workers,
+        fp_index=DEFAULT_FP_INDEX if fp_index is None else fp_index,
+        spec_name=sm.root_name or spec_name,
+        model_name=os.path.basename(model_dir),
     )

@@ -347,10 +347,10 @@ class Evaluator:
             if not (isinstance(a, frozenset) and isinstance(b, frozenset)):
                 raise StructEvalError(f"{sym} expects sets")
             return {r"\cup": a | b, r"\cap": a & b, "\\": a - b}[sym]
-        if sym in ("+", "-"):
+        if sym in ("+", "-", "*"):
             if not (isinstance(a, int) and isinstance(b, int)):
                 raise StructEvalError(f"{sym} expects integers")
-            return a + b if sym == "+" else a - b
+            return {"+": a + b, "-": a - b, "*": a * b}[sym]
         if sym == "..":
             return frozenset(range(a, b + 1))
         if sym == r"\o":

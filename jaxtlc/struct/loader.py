@@ -50,6 +50,21 @@ def _parse_const_literal(text: str):
         return t[1:-1]
     if t.lstrip("-").isdigit():
         return int(t)
+    if t.startswith("{") and t.endswith("}"):
+        # model-value set: CONSTANT RM = {r1, r2}.  Flat sets of simple
+        # literals only - nested braces or quoted commas would split
+        # wrong, so they are a loud error, not a garbage constant.
+        inner = t[1:-1].strip()
+        if not inner:
+            return frozenset()
+        if "{" in inner or '"' in inner:
+            raise StructLoadError(
+                f"unsupported constant set literal {t!r} (flat "
+                "model-value/number sets only)"
+            )
+        return frozenset(
+            _parse_const_literal(x) for x in inner.split(",")
+        )
     if t == "defaultInitValue":
         return DEFAULT_INIT
     # TLC model value: an atom equal only to itself; the hand oracle

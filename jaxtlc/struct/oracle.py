@@ -56,7 +56,16 @@ def bfs(
         env = dict(ev.constants)
         env.update(zip(system.variables, st))
         for name, ast in invariants.items():
-            if ev.eval(ast, env) is not True:
+            try:
+                ok = ev.eval(ast, env) is True
+            except StructEvalError as e:
+                # TLC reports an invariant that cannot be evaluated on a
+                # reachable state (e.g. an out-of-range index) as an
+                # error with a trace; same here, as a violation kind
+                violations.append((f"{name} (evaluation error: {e})",
+                                   st))
+                continue
+            if not ok:
                 violations.append((name, st))
 
     for s in inits:
@@ -121,6 +130,124 @@ def state_env(system: ActionSystem, st: tuple) -> dict:
     env = dict(system.ev.constants)
     env.update(zip(system.variables, st))
     return env
+
+
+def state_to_tla(system: ActionSystem, st: tuple) -> str:
+    """TLA-conjunct rendering of a structural state (TLC trace style)."""
+    from ..spec.pretty import value_to_tla
+
+    return "\n".join(
+        f"/\\ {v} = {value_to_tla(val)}"
+        for v, val in zip(system.variables, st)
+    )
+
+
+class LivenessResult(NamedTuple):
+    name: str
+    holds: bool
+    lasso_prefix: Optional[List[tuple]]
+    lasso_cycle: Optional[List[tuple]]
+
+
+def check_leads_to(system: ActionSystem, p_ast, q_ast, name: str = "",
+                   max_states: int = 1_000_000) -> LivenessResult:
+    """P ~> Q under WF_vars(Next) over the structural relation - the
+    same greatest-fixpoint peeling as the generic path (gen.oracle):
+    survive(s) iff ~Q(s) and (no state-changing successor, or some
+    state-changing successor survives); a violation is a reachable
+    surviving P-state."""
+    ev = system.ev
+
+    def holds(ast, st) -> bool:
+        env = dict(ev.constants)
+        env.update(zip(system.variables, st))
+        return ev.eval(ast, env) is True
+
+    init_states = system.initial_states()
+    states: Dict[tuple, int] = {}
+    order: List[tuple] = []
+    edges: Dict[int, List[int]] = {}
+    frontier = deque()
+    init_ids = []
+    for st in init_states:
+        if st not in states:
+            init_ids.append(len(order))
+            states[st] = len(order)
+            order.append(st)
+            frontier.append(st)
+    while frontier:
+        st = frontier.popleft()
+        sid = states[st]
+        outs = []
+        for _, nxt in system.successors(st):
+            if nxt == st:
+                continue
+            if nxt not in states:
+                if len(states) >= max_states:
+                    raise RuntimeError("liveness graph bound exceeded")
+                states[nxt] = len(order)
+                order.append(nxt)
+                frontier.append(nxt)
+            outs.append(states[nxt])
+        edges[sid] = outs
+    n = len(order)
+    alive = [not holds(q_ast, s) for s in order]
+    changed = True
+    while changed:
+        changed = False
+        for i in range(n):
+            if not alive[i]:
+                continue
+            outs = edges[i]
+            if outs and not any(alive[j] for j in outs):
+                alive[i] = False
+                changed = True
+    for i in range(n):
+        if alive[i] and holds(p_ast, order[i]):
+            prefix = _path_to(edges, init_ids, i)
+            cycle = _alive_tail(edges, i, alive)
+            return LivenessResult(
+                name, False,
+                [order[j] for j in prefix],
+                [order[j] for j in cycle],
+            )
+    return LivenessResult(name, True, None, None)
+
+
+def _path_to(edges, srcs, dst):
+    """BFS path from ANY of `srcs` to dst (multi-initial-state specs)."""
+    if isinstance(srcs, int):
+        srcs = [srcs]
+    prev = {s: None for s in srcs}
+    q = deque(srcs)
+    while q:
+        u = q.popleft()
+        if u == dst:
+            break
+        for v in edges[u]:
+            if v not in prev:
+                prev[v] = u
+                q.append(v)
+    path, cur = [], dst
+    while cur is not None:
+        path.append(cur)
+        cur = prev[cur]
+    return list(reversed(path))
+
+
+def _alive_tail(edges, start, alive):
+    seen = {start: 0}
+    seq = [start]
+    cur = start
+    while True:
+        outs = [j for j in edges[cur] if alive[j]]
+        if not outs:
+            return seq
+        cur = outs[0]
+        if cur in seen:
+            return seq[seen[cur]:]
+        seen[cur] = len(seq)
+        seq.append(cur)
 
 
 def violation_trace(system: ActionSystem, invariants: Dict[str, tuple],

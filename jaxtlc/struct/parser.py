@@ -552,12 +552,22 @@ class _ExprParser:
         return left
 
     def parse_add(self) -> tuple:
-        left = self.parse_concat()
+        left = self.parse_mul()
         while True:
             t = self.peek()
             if t.kind == "sym" and t.val in ("+", "-"):
                 self.next()
-                left = ("binop", t.val, left, self.parse_concat())
+                left = ("binop", t.val, left, self.parse_mul())
+            else:
+                return left
+
+    def parse_mul(self) -> tuple:
+        left = self.parse_concat()
+        while True:
+            t = self.peek()
+            if t.kind == "sym" and t.val == "*":
+                self.next()
+                left = ("binop", "*", left, self.parse_concat())
             else:
                 return left
 

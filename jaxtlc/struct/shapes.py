@@ -267,12 +267,13 @@ def universe(shape: Optional[Shape], limit: int = ENUM_LIMIT) -> List:
         return out
     if isinstance(shape, SSeq):
         eu = universe(shape.elem, limit)
+        total = sum(len(eu) ** k for k in range(shape.cap + 1))
+        if total > limit:
+            raise ShapeError("sequence universe too large")
         out = [()]
         layer = [()]
         for _ in range(shape.cap):
             layer = [t + (e,) for t in layer for e in eu]
-            if len(out) + len(layer) > limit:
-                raise ShapeError("sequence universe too large")
             out.extend(layer)
         return out
     if isinstance(shape, SFun):
@@ -343,9 +344,23 @@ class ShapeInference:
                 self.var_shapes[v] = join(
                     self.var_shapes[v], shape_of_value(val)
                 )
+        hints = getattr(self, "hints", {})
         for it in range(max_iters):
             before = dict(self.var_shapes)
             self._pass_next()
+            if it >= 2:
+                # widen growing int ranges up a threshold ladder so
+                # counter-style specs (x' = x + 1 under a guard the
+                # abstract pass cannot see) converge; the kernel traps
+                # at runtime if a real value escapes the widened range
+                for v in self.variables:
+                    self.var_shapes[v] = _widen(before.get(v),
+                                                self.var_shapes[v])
+            for v, hint in hints.items():
+                # TypeOK-declared bounds keep universes tight (one value
+                # of slack, see typeok_hints); clamping LAST keeps the
+                # widen/clamp pair convergent
+                self.var_shapes[v] = _clamp(self.var_shapes[v], hint)
             if self.var_shapes == before:
                 return {v: s for v, s in self.var_shapes.items()}
         raise ShapeError("shape inference did not converge")
@@ -608,11 +623,15 @@ class ShapeInference:
             if sym == r"\cup":
                 return SSet(join(ea, eb))
             return SSet(ea)
-        if sym in ("+", "-"):
+        if sym in ("+", "-", "*"):
             if isinstance(a, SInt) and isinstance(b, SInt):
                 if sym == "+":
                     return SInt(a.lo + b.lo, a.hi + b.hi)
-                return SInt(a.lo - b.hi, a.hi - b.lo)
+                if sym == "-":
+                    return SInt(a.lo - b.hi, a.hi - b.lo)
+                corners = [a.lo * b.lo, a.lo * b.hi, a.hi * b.lo,
+                           a.hi * b.hi]
+                return SInt(min(corners), max(corners))
             return SInt(-(1 << 30), 1 << 30)
         if sym == "..":
             if isinstance(a, SInt) and isinstance(b, SInt):
@@ -801,6 +820,42 @@ class ShapeInference:
         raise ShapeError(f"cannot abstract call {name}")
 
 
+_INT_THRESHOLDS = (1, 3, 7, 15, 31, 63, 127, 255, 511, 1023, 4095,
+                   16383, 65535)
+
+
+def _widen(old: Optional[Shape], new: Optional[Shape]) -> Optional[Shape]:
+    """Accelerate int-range growth to the next threshold (sticky at the
+    top) so the fixpoint terminates; recurses through containers."""
+    if new is None or old is None or old == new:
+        return new
+    if isinstance(new, SInt) and isinstance(old, SInt):
+        hi = new.hi
+        if hi > old.hi:
+            hi = next((t for t in _INT_THRESHOLDS if t >= hi),
+                      _INT_THRESHOLDS[-1])
+        lo = new.lo
+        if lo < old.lo:
+            lo = -next((t for t in _INT_THRESHOLDS if t >= -lo),
+                       _INT_THRESHOLDS[-1]) - 1
+        return SInt(min(lo, hi), hi)
+    if isinstance(new, SRec) and isinstance(old, SRec):
+        return SRec(tuple(
+            (f, _widen(old.field(f)[0] if old.field(f) else None, s), o)
+            for f, s, o in new.fields
+        ))
+    if isinstance(new, SSet) and isinstance(old, SSet):
+        return SSet(_widen(old.elem, new.elem))
+    if isinstance(new, SSeq) and isinstance(old, SSeq):
+        return SSeq(_widen(old.elem, new.elem), new.cap)
+    if isinstance(new, SUnion) and isinstance(old, SUnion):
+        olds = {type(a): a for a in old.alts}
+        return SUnion(tuple(
+            _widen(olds.get(type(a)), a) for a in new.alts
+        ))
+    return new
+
+
 def _mentions_prime_static(ast, defs, _seen=None) -> bool:
     if _seen is None:
         _seen = set()
@@ -821,6 +876,94 @@ def _mentions_prime_static(ast, defs, _seen=None) -> bool:
     return False
 
 
-def infer_shapes(ev: Evaluator, variables, init_ast, next_ast
+def typeok_hints(ev: Evaluator, invariants: Dict[str, tuple],
+                 variables) -> Dict[str, Shape]:
+    """Extract per-variable bounds from TypeOK-style conjuncts: the same
+    place TLC users document type bounds (`x \\in 0..N`,
+    `f \\in [S -> D]`).  Ints get one value of slack beyond the declared
+    bound so an off-by-one violation still encodes faithfully and is
+    reported as the invariant violation it is (values beyond the slack
+    hit the runtime range trap instead)."""
+    hints: Dict[str, Shape] = {}
+
+    def dom_shape(ast) -> Optional[Shape]:
+        """ELEMENT shape of a constant set expression, with int slack."""
+        try:
+            v = ev.eval(ast, {})
+        except Exception:
+            return None
+        if not isinstance(v, frozenset):
+            return None
+        sh = None
+        for x in v:
+            sh = join(sh, shape_of_value(x))
+        return _slack(sh)
+
+    def visit(ast):
+        if not isinstance(ast, tuple):
+            return
+        if ast[0] == "and":
+            for x in ast[1]:
+                visit(x)
+            return
+        if ast[0] == "cmp" and ast[1] == r"\in" and ast[2][0] == "name" \
+                and ast[2][1] in variables:
+            var = ast[2][1]
+            rhs = ast[3]
+            if rhs[0] == "funcset":
+                keys_sh = dom_shape(rhs[1])
+                val_sh = dom_shape(rhs[2])
+                if val_sh is not None and isinstance(keys_sh, SAtoms):
+                    hints[var] = SRec(tuple(
+                        (k, val_sh, False)
+                        for k in sorted(keys_sh.atoms)
+                    ))
+            else:
+                sh = dom_shape(rhs)
+                if sh is not None:
+                    hints[var] = sh
+
+    for ast in invariants.values():
+        visit(ast)
+    return hints
+
+
+def _slack(sh: Optional[Shape]) -> Optional[Shape]:
+    if isinstance(sh, SInt):
+        return SInt(sh.lo - 1, sh.hi + 1)
+    return sh
+
+
+def _clamp(sh: Optional[Shape], hint: Optional[Shape]) -> Optional[Shape]:
+    """Meet `sh` with a TypeOK hint (ints narrowed; containers
+    recursed); anything the hint does not constrain stays as inferred."""
+    if sh is None or hint is None:
+        return sh
+    if isinstance(sh, SInt) and isinstance(hint, SInt):
+        lo = max(sh.lo, hint.lo)
+        hi = min(sh.hi, hint.hi)
+        return SInt(lo, max(lo, hi))
+    if isinstance(sh, SRec) and isinstance(hint, SRec):
+        return SRec(tuple(
+            (f, _clamp(s, hint.field(f)[0] if hint.field(f) else None),
+             o)
+            for f, s, o in sh.fields
+        ))
+    if isinstance(sh, SSet) and isinstance(hint, SSet):
+        return SSet(_clamp(sh.elem, hint.elem))
+    if isinstance(sh, SSeq):
+        elem_hint = hint.elem if isinstance(hint, SSeq) else (
+            hint if isinstance(hint, SInt) else None)
+        return SSeq(_clamp(sh.elem, elem_hint), sh.cap)
+    if isinstance(sh, SUnion):
+        return SUnion(tuple(_clamp(a, hint) if isinstance(a, type(hint))
+                            else a for a in sh.alts))
+    return sh
+
+
+def infer_shapes(ev: Evaluator, variables, init_ast, next_ast,
+                 hints: Optional[Dict[str, Shape]] = None
                  ) -> Dict[str, Shape]:
-    return ShapeInference(ev, variables, init_ast, next_ast).run()
+    inf = ShapeInference(ev, variables, init_ast, next_ast)
+    inf.hints = hints or {}
+    return inf.run()

@@ -60,25 +60,35 @@ def test_resolve_reference_model():
 
 
 def test_resolve_unknown_spec_needs_module_file(tmp_path):
-    # non-KubeAPI root specs now route to the generic frontend (E1), which
-    # needs the module source next to the config
+    # non-KubeAPI root specs without a sibling module route to the
+    # structural frontend, whose EXTENDS resolution names what's missing
     (tmp_path / "MC.cfg").write_text("SPECIFICATION Spec\n")
     (tmp_path / "MC.tla").write_text(
         "---- MODULE MC ----\nEXTENDS Raft, TLC\n====\n"
     )
-    with pytest.raises(ValueError, match="no Raft.tla next to the config"):
+    with pytest.raises(ValueError,
+                       match="structural frontend cannot load"):
         resolve(str(tmp_path / "MC.cfg"))
 
 
-def test_resolve_unknown_spec_outside_subset(tmp_path):
-    # a module the generic parser cannot handle is a clear subset error
+def test_resolve_outside_gen_subset_falls_back_to_struct(tmp_path):
+    # a module the gen-subset parser cannot handle now falls back to the
+    # structural frontend instead of erroring (E1: no rejected specs);
+    # forcing -frontend gen still yields the precise subset diagnostic
+    from jaxtlc.frontend.model import StructRunSpec
+
     (tmp_path / "MC.cfg").write_text("SPECIFICATION Spec\n")
     (tmp_path / "MC.tla").write_text(
         "---- MODULE MC ----\nEXTENDS Raft, TLC\n====\n"
     )
     (tmp_path / "Raft.tla").write_text(
         "---- MODULE Raft ----\nVARIABLES log\n"
-        "Init == log = CHOOSE x \\in {} : TRUE\n====\n"
+        "Init == log = CHOOSE x \\in {1, 2} : x > 1\n"
+        "Next == log' = log\n"
+        "Spec == Init /\\ [][Next]_log\n====\n"
     )
+    spec = resolve(str(tmp_path / "MC.cfg"))
+    assert isinstance(spec, StructRunSpec)
+    assert spec.structmodel.system.initial_states() == [(2,)]
     with pytest.raises(ValueError, match="PlusCal-translation subset"):
-        resolve(str(tmp_path / "MC.cfg"))
+        resolve(str(tmp_path / "MC.cfg"), frontend="gen")
