@@ -188,24 +188,45 @@ class LivenessResult(NamedTuple):
     lasso_cycle: Optional[List[State]]
 
 
-def check_leads_to(spec: GenSpec, p_ast, q_ast, name: str = "",
-                   max_states: int = 1_000_000) -> LivenessResult:
-    """P ~> Q under WF_vars(Next) on the reachable graph.
+def _action_process(label: str) -> str:
+    """The fairness unit of an edge: the first bound parameter value of
+    the firing action ("RequestVote(n1,n2)" -> "n1"), or the action name
+    for parameterless actions - mirroring the KubeAPI path where WF is
+    per PlusCal process (engine/liveness.py fair_surviving_set)."""
+    if "(" in label:
+        return label[label.index("(") + 1:-1].split(",")[0]
+    return label
 
-    survive(s) iff ~Q(s) and (no state-changing successor at all, or some
-    state-changing successor survives) - greatest fixpoint by peeling; a
-    violation is a reachable surviving state satisfying P (the lasso is
-    prefix + a cycle/terminal tail inside ~Q)."""
+
+def check_leads_to(spec: GenSpec, p_ast, q_ast, name: str = "",
+                   max_states: int = 1_000_000,
+                   fairness: str = "wf_next") -> LivenessResult:
+    """P ~> Q on the reachable graph under the selected fairness.
+
+    wf_next (the spec's literal WF_vars(Next)): survive(s) iff ~Q(s) and
+    (no state-changing successor at all, or some state-changing
+    successor survives) - greatest fixpoint by peeling.
+
+    wf_process (per-process weak fairness, the KubeAPI path's second
+    mode): a violation suffix eventually stays inside one SCC S of the
+    ~Q subgraph; S hosts a fair behavior iff for every process p, p has
+    an internal step in S or p is disabled at some state of S; terminal
+    ~Q states host a fair stutter.  A violation is a reachable P-state
+    that can reach such a fair core within ~Q.
+
+    The lasso is prefix + a cycle/terminal tail inside ~Q either way."""
     init = initial_state(spec)
     states = {init: 0}
     order = [init]
     edges: Dict[int, List[int]] = {}
+    edge_proc: Dict[int, List[str]] = {}
     frontier = deque([init])
     while frontier:
         st = frontier.popleft()
         sid = states[st]
         outs = []
-        for _, nxt, changed in successors(spec, st):
+        procs = []
+        for label, nxt, changed in successors(spec, st):
             if not changed:
                 continue
             if nxt not in states:
@@ -215,8 +236,15 @@ def check_leads_to(spec: GenSpec, p_ast, q_ast, name: str = "",
                 order.append(nxt)
                 frontier.append(nxt)
             outs.append(states[nxt])
+            procs.append(_action_process(label))
         edges[sid] = outs
+        edge_proc[sid] = procs
     n = len(order)
+    if fairness == "wf_process":
+        return _check_leads_to_wf_process(
+            spec, name, p_ast, q_ast, order, edges, edge_proc)
+    if fairness != "wf_next":
+        raise ValueError(f"unknown fairness mode {fairness!r}")
     in_h = [not texpr.evaluate(q_ast, state_env(spec, s)) for s in order]
     # peel: alive = in_h; repeatedly drop states whose every state-changing
     # successor is dead, unless they have no state-changing successor
@@ -242,6 +270,110 @@ def check_leads_to(spec: GenSpec, p_ast, q_ast, name: str = "",
                 [order[j] for j in cycle],
             )
     return LivenessResult(name, True, None, None)
+
+
+def _check_leads_to_wf_process(spec, name, p_ast, q_ast, order, edges,
+                               edge_proc) -> LivenessResult:
+    """SCC-based per-process weak fairness (see check_leads_to doc)."""
+    import numpy as np
+
+    from ..engine.liveness import _sccs
+
+    n = len(order)
+    in_h = [not texpr.evaluate(q_ast, state_env(spec, s)) for s in order]
+    all_procs = sorted({p for ps in edge_proc.values() for p in ps})
+    pid = {p: i for i, p in enumerate(all_procs)}
+    n_procs = len(all_procs)
+    enabled = np.zeros((n, max(n_procs, 1)), dtype=bool)
+    hs, hd, hp = [], [], []
+    for s in range(n):
+        for d, p in zip(edges[s], edge_proc[s]):
+            enabled[s, pid[p]] = True
+            if in_h[s] and in_h[d]:
+                hs.append(s)
+                hd.append(d)
+                hp.append(pid[p])
+    hs = np.asarray(hs, np.int64)
+    hd = np.asarray(hd, np.int64)
+    hp = np.asarray(hp, np.int64)
+    comp = _sccs(n, hs, hd)
+    ncomp = int(comp.max()) + 1 if n else 0
+    internal = comp[hs] == comp[hd] if len(hs) else np.zeros(0, bool)
+    cyclic = np.zeros(ncomp, bool)
+    if len(hs):
+        np.add.at(cyclic, comp[hs[internal]], True)
+    has_pedge = np.zeros((ncomp, max(n_procs, 1)), bool)
+    if len(hs):
+        has_pedge[comp[hs[internal]], hp[internal]] = True
+    some_disabled = np.zeros((ncomp, max(n_procs, 1)), bool)
+    hidx = np.asarray([i for i in range(n) if in_h[i]], np.int64)
+    for p in range(n_procs):
+        np.logical_or.at(some_disabled[:, p], comp[hidx],
+                         ~enabled[hidx, p])
+    fair_scc = cyclic & (has_pedge | some_disabled).all(axis=1)
+    terminal = np.asarray(
+        [in_h[i] and not edges[i] for i in range(n)], bool
+    )
+    fair_core = terminal.copy()
+    if len(hidx):
+        fair_core[hidx] |= fair_scc[comp[hidx]]
+    # reverse reachability within H to the fair core
+    can_stay = fair_core.copy()
+    rev: Dict[int, List[int]] = {}
+    for s, d in zip(hs, hd):
+        rev.setdefault(int(d), []).append(int(s))
+    stack = [int(i) for i in np.flatnonzero(fair_core)]
+    while stack:
+        d = stack.pop()
+        for s in rev.get(d, ()):
+            if not can_stay[s]:
+                can_stay[s] = True
+                stack.append(s)
+    h_edges = {
+        j: ([d for d, p in zip(edges[j], edge_proc[j]) if in_h[d]]
+            if in_h[j] else [])
+        for j in range(n)
+    }
+    for i in range(n):
+        if can_stay[i] and texpr.evaluate(
+            p_ast, state_env(spec, order[i])
+        ):
+            # evidence must loop inside the FAIR CORE, not merely in a
+            # transit SCC of can_stay (which would be a cycle the very
+            # fairness assumption forbids): extend the prefix through H
+            # to a core state, then walk within the core
+            prefix = _path_to(edges, 0, i, n)
+            mid = _bfs_to_set(h_edges, i, fair_core)
+            entry = mid[-1]
+            cycle = _alive_tail(h_edges, entry, fair_core)
+            return LivenessResult(
+                name, False,
+                [order[j] for j in prefix + mid[1:]],
+                [order[j] for j in cycle],
+            )
+    return LivenessResult(name, True, None, None)
+
+
+def _bfs_to_set(edges, src, targets):
+    """Shortest path (node ids) from src to any node with targets[id]."""
+    prev = {src: None}
+    q = deque([src])
+    goal = src if targets[src] else None
+    while q and goal is None:
+        u = q.popleft()
+        for v in edges.get(u, ()):
+            if v not in prev:
+                prev[v] = u
+                if targets[v]:
+                    goal = v
+                    break
+                q.append(v)
+    assert goal is not None, "can_stay state cannot reach the fair core"
+    path, cur = [], goal
+    while cur is not None:
+        path.append(cur)
+        cur = prev[cur]
+    return list(reversed(path))
 
 
 def _path_to(edges, src, dst, n):

@@ -447,6 +447,10 @@ class ModuleParser:
                          param_values: Tuple[Tuple[str, ...], ...]
                          ) -> List[Action]:
         disj = disj.strip()
+        if disj.startswith("\\E"):
+            # accept the unparenthesized form too: the translation
+            # emits parens, hand-written specs often do not
+            disj = f"({disj})"
         em2 = _EXISTS2_RE.match(disj)
         if em2:
             return self._expand_call(

@@ -1,0 +1,123 @@
+"""Per-process weak fairness for generic specs (E8, VERDICT r4 item 7).
+
+The KubeAPI path has two fairness modes (engine/liveness.py); the gen
+path now mirrors them: a property that fails under the spec's literal
+WF_vars(Next) (a behavior may neglect a continuously-enabled process
+forever) but holds under per-process WF - and a variant where even
+per-process WF admits the violation because the neglected process is
+disabled somewhere in the loop (the some_disabled escape clause).
+"""
+
+from jaxtlc.gen import oracle as go
+from jaxtlc.gen.tla_parse import load_genspec
+from jaxtlc.spec import texpr
+
+_FAIRDEMO = """---- MODULE FairDemo ----
+EXTENDS Naturals
+VARIABLES done, tick
+
+Init == /\\ done = 0
+        /\\ tick = 0
+
+TypeOK == /\\ done \\in 0..1
+          /\\ tick \\in 0..1
+
+Spin == /\\ tick' = 1 - tick
+        /\\ UNCHANGED <<done>>
+
+Finish == /\\ done = 0
+          /\\ {GUARD}done' = 1
+          /\\ UNCHANGED <<tick>>
+
+Next == \\/ Spin
+        \\/ Finish
+
+Spec == /\\ Init
+        /\\ [][Next]_<<done, tick>>
+        /\\ WF_vars(Next)
+
+Completes == done = 0 ~> done = 1
+====
+"""
+
+
+def _spec(tmp_path, guard=""):
+    p = tmp_path / "FairDemo.tla"
+    p.write_text(_FAIRDEMO.replace("{GUARD}", guard))
+    return load_genspec(str(p), {}, ["TypeOK"], ["Completes"])
+
+
+def test_wf_process_stronger_than_wf_next(tmp_path):
+    spec = _spec(tmp_path)
+    p_ast, q_ast = spec.properties["Completes"]
+    # WF_vars(Next): spinning forever is admissible -> violated
+    res = go.check_leads_to(spec, p_ast, q_ast, "Completes",
+                            fairness="wf_next")
+    assert not res.holds
+    assert res.lasso_prefix and res.lasso_cycle
+    for st in res.lasso_cycle:
+        assert not texpr.evaluate(q_ast, go.state_env(spec, st))
+    # per-process WF: Finish is continuously enabled while done = 0, so
+    # neglecting it forever is inadmissible -> holds
+    res2 = go.check_leads_to(spec, p_ast, q_ast, "Completes",
+                             fairness="wf_process")
+    assert res2.holds
+
+
+def test_wf_process_disabled_escape(tmp_path):
+    # Finish now needs tick = 1; the spin loop visits tick = 0 where
+    # Finish is disabled, so even per-process WF admits neglecting it
+    spec = _spec(tmp_path, guard="tick = 1\n          /\\ ")
+    p_ast, q_ast = spec.properties["Completes"]
+    res = go.check_leads_to(spec, p_ast, q_ast, "Completes",
+                            fairness="wf_process")
+    assert not res.holds
+    assert res.lasso_prefix and res.lasso_cycle
+    for st in res.lasso_cycle:
+        assert not texpr.evaluate(q_ast, go.state_env(spec, st))
+    # under plain wf_next it is of course also violated
+    res2 = go.check_leads_to(spec, p_ast, q_ast, "Completes",
+                             fairness="wf_next")
+    assert not res2.holds
+
+
+def test_wf_process_per_binding_processes(tmp_path):
+    """Parameterized actions: the fairness unit is the first binding
+    (the PlusCal self), not the whole action."""
+    mod = """---- MODULE PerProc ----
+EXTENDS Naturals
+CONSTANTS Procs
+VARIABLES at
+
+Init == at = [p \\in Procs |-> 0]
+
+TypeOK == at \\in [Procs -> 0..1]
+
+Step(p) == /\\ at[p] = 0
+           /\\ at' = [at EXCEPT ![p] = 1]
+
+Reset(p) == /\\ at[p] = 1
+            /\\ at' = [at EXCEPT ![p] = 0]
+
+Next == \\/ \\E p \\in Procs : Step(p)
+        \\/ \\E p \\in Procs : Reset(p)
+
+Spec == /\\ Init
+        /\\ [][Next]_<<at>>
+        /\\ WF_vars(Next)
+
+AEventually == at["a"] = 0 ~> at["a"] = 1
+====
+"""
+    p = tmp_path / "PerProc.tla"
+    p.write_text(mod)
+    spec = load_genspec(str(p), {"Procs": "{a, b}"}, ["TypeOK"],
+                        ["AEventually"])
+    p_ast, q_ast = spec.properties["AEventually"]
+    # wf_next: b can step/reset forever while a never moves -> violated
+    assert not go.check_leads_to(spec, p_ast, q_ast, "AE",
+                                 fairness="wf_next").holds
+    # per-process WF: process a (Step(a)) is continuously enabled at
+    # at["a"] = 0, so it must eventually fire -> holds
+    assert go.check_leads_to(spec, p_ast, q_ast, "AE",
+                             fairness="wf_process").holds
