@@ -1,0 +1,110 @@
+---- MODULE RaftReplication ----
+(***************************************************************************)
+(* Raft leader election PLUS log replication - the deep-state-graph        *)
+(* workload BASELINE.json names ("etcd Raft TLA+ spec (leader election +   *)
+(* log replication)").  Bounded logs are real sequences (Append, whole-log *)
+(* adoption, dynamic last-entry indexing); commit uses general-N quorum    *)
+(* counting, and elections carry Raft's up-to-dateness restriction (last   *)
+(* entry term, then length) - the rule that makes committed prefixes       *)
+(* stable across leader changes.  Runs through the structural frontend:    *)
+(* host interpreter and compiled device engine, differentially pinned.     *)
+(***************************************************************************)
+EXTENDS Naturals, Sequences, FiniteSets, TLC
+
+CONSTANTS Nodes, MaxLog, MaxTerm
+
+VARIABLES role, term, log, commitIdx
+
+vars == <<role, term, log, commitIdx>>
+
+NodeCount == Cardinality(Nodes)
+
+LastTerm(s) == IF Len(s) = 0 THEN 0 ELSE s[Len(s)]
+
+(* Raft's vote restriction: candidate c is at least as up-to-date as v *)
+UpToDate(c, v) == \/ LastTerm(log[c]) > LastTerm(log[v])
+                  \/ /\ LastTerm(log[c]) = LastTerm(log[v])
+                     /\ Len(log[c]) >= Len(log[v])
+
+Init == /\ role = [n \in Nodes |-> "follower"]
+        /\ term = [n \in Nodes |-> 0]
+        /\ log = [n \in Nodes |-> << >>]
+        /\ commitIdx = [n \in Nodes |-> 0]
+
+(* a node with the highest term wins an election if a quorum finds its
+   log up to date; everyone else steps down *)
+Elect(n) == /\ term[n] < MaxTerm
+            /\ \A m \in Nodes : term[m] <= term[n]
+            /\ 2 * Cardinality({m \in Nodes : UpToDate(n, m)}) > NodeCount
+            /\ role' = [m \in Nodes |-> IF m = n THEN "leader"
+                                        ELSE "follower"]
+            /\ term' = [term EXCEPT ![n] = @ + 1]
+            /\ UNCHANGED <<log, commitIdx>>
+
+(* the leader appends a client entry stamped with its term *)
+ClientRequest(n) == /\ role[n] = "leader"
+                    /\ Len(log[n]) < MaxLog
+                    /\ log' = [log EXCEPT ![n] = Append(@, term[n])]
+                    /\ UNCHANGED <<role, term, commitIdx>>
+
+(* AppendEntries, whole-log form: a behind follower adopts the leader's
+   log and term *)
+Replicate(n, f) == /\ role[n] = "leader"
+                   /\ n # f
+                   /\ term[f] <= term[n]
+                   /\ log[f] # log[n]
+                   /\ log' = [log EXCEPT ![f] = log[n]]
+                   /\ term' = [term EXCEPT ![f] = term[n]]
+                   /\ UNCHANGED <<role, commitIdx>>
+
+(* the leader commits the next index once a quorum stores its log up to
+   there with the leader's own content (whole-log adoption makes length
+   agreement sufficient) *)
+AdvanceCommit(n) ==
+    /\ role[n] = "leader"
+    /\ commitIdx[n] < Len(log[n])
+    /\ 2 * Cardinality({m \in Nodes : \/ m = n
+                                      \/ /\ Len(log[m]) >= commitIdx[n] + 1
+                                         /\ log[m] = log[n]}) > NodeCount
+    /\ commitIdx' = [commitIdx EXCEPT ![n] = @ + 1]
+    /\ UNCHANGED <<role, term, log>>
+
+(* a follower learns the commit index from the leader it mirrors *)
+LearnCommit(n, f) == /\ role[n] = "leader"
+                     /\ n # f
+                     /\ log[f] = log[n]
+                     /\ commitIdx[f] < commitIdx[n]
+                     /\ commitIdx' = [commitIdx EXCEPT ![f] = @ + 1]
+                     /\ UNCHANGED <<role, term, log>>
+
+Next == \/ \E n \in Nodes : \/ Elect(n)
+                            \/ ClientRequest(n)
+                            \/ AdvanceCommit(n)
+        \/ \E n \in Nodes : \E f \in Nodes : \/ Replicate(n, f)
+                                             \/ LearnCommit(n, f)
+
+Spec == /\ Init
+        /\ [][Next]_vars
+
+TypeOK == /\ role \in [Nodes -> {"leader", "follower"}]
+          /\ term \in [Nodes -> 0..MaxTerm]
+          /\ commitIdx \in [Nodes -> 0..MaxLog]
+          /\ \A n \in Nodes : /\ Len(log[n]) <= MaxLog
+                              /\ \A i \in 1..MaxLog :
+                                    i <= Len(log[n]) =>
+                                        /\ log[n][i] >= 1
+                                        /\ log[n][i] <= MaxTerm
+
+AtMostOneLeader == \A m, n \in Nodes : \/ m = n
+                                       \/ role[m] = "follower"
+                                       \/ role[n] = "follower"
+
+(* commit safety: entries below both nodes' commit indexes agree *)
+CommittedAgree ==
+    \A m, n \in Nodes : \A i \in 1..MaxLog :
+        (/\ i <= commitIdx[m]
+         /\ i <= commitIdx[n]) => log[m][i] = log[n][i]
+
+(* a commit index never runs past the log it indexes *)
+CommitWithinLog == \A n \in Nodes : commitIdx[n] <= Len(log[n])
+====

@@ -1,0 +1,65 @@
+"""RaftReplication family: leader election + log replication, the deep
+workload BASELINE.json names (VERDICT r4 item 5) - bounded sequence
+logs, whole-log AppendEntries, general-N quorum counting, and Raft's
+election up-to-dateness restriction, through the structural frontend's
+host interpreter and compiled device engine.
+"""
+
+import pytest
+
+from jaxtlc.struct.engine import check_struct
+from jaxtlc.struct.loader import load
+from jaxtlc.struct.oracle import bfs
+
+CFG = "specs/RaftReplication.toolbox/Model_1/MC.cfg"
+TLA = "specs/RaftReplication.toolbox/Model_1/RaftReplication.tla"
+
+# oracle-pinned counts for the shipped Model_1 (3 nodes, MaxLog 2,
+# MaxTerm 3)
+EXPECT = (17431, 7279, 14)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return load(CFG)
+
+
+def test_oracle_counts_and_invariants(model):
+    r = bfs(model.system, model.invariants, check_deadlock=False)
+    assert not r.violations
+    assert (r.generated, r.distinct, r.depth) == EXPECT
+    # every protocol phase fires
+    for act in ("Elect", "ClientRequest", "Replicate", "AdvanceCommit",
+                "LearnCommit"):
+        assert r.action_generated.get(act, 0) > 0, act
+
+
+@pytest.mark.slow
+def test_device_matches_oracle(model):
+    ro = bfs(model.system, model.invariants, check_deadlock=False)
+    rd = check_struct(model, chunk=256, queue_capacity=1 << 13,
+                      fp_capacity=1 << 15, check_deadlock=False)
+    assert rd.violation == 0
+    assert (rd.generated, rd.distinct, rd.depth) == EXPECT
+    assert rd.action_generated == ro.action_generated
+    assert sum(rd.action_distinct.values()) == ro.distinct - 1
+
+
+def test_stale_leader_breaks_commit_safety(tmp_path):
+    """Dropping the up-to-dateness restriction from Elect lets a leader
+    with a stale log overwrite a committed quorum - the exact anomaly
+    the restriction exists to prevent.  The checker catches it (the
+    commit index outruns a truncated log)."""
+    src = open(TLA).read()
+    needle = ("/\\ 2 * Cardinality({m \\in Nodes : UpToDate(n, m)}) "
+              "> NodeCount\n            ")
+    assert needle in src
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "RaftReplication.tla").write_text(src.replace(needle, "", 1))
+    (d / "MC.cfg").write_text(open(CFG).read())
+    m = load(str(d / "MC.cfg"))
+    r = bfs(m.system, m.invariants, check_deadlock=False)
+    assert r.violations
+    kind = r.violations[0][0]
+    assert kind.startswith(("CommitWithinLog", "CommittedAgree"))

@@ -1,0 +1,85 @@
+"""TwoPhase family: a spec NOT authored for the gen subset (VERDICT r4
+item 8) - heterogeneous record messages, set-valued state, subset tests
+- exercised end-to-end through the structural frontend: host oracle,
+compiled device engine, violation machinery, and the CLI contract.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from jaxtlc.struct.engine import check_struct
+from jaxtlc.struct.loader import load
+from jaxtlc.struct.oracle import bfs, violation_trace
+
+CFG = "specs/TwoPhase.toolbox/Model_1/MC.cfg"
+TLA = "specs/TwoPhase.toolbox/Model_1/TwoPhase.tla"
+
+
+@pytest.fixture(scope="module")
+def model():
+    return load(CFG)
+
+
+def test_oracle_counts_and_invariants(model):
+    r = bfs(model.system, model.invariants, check_deadlock=False)
+    assert not r.violations
+    assert (r.generated, r.distinct, r.depth) == (114, 56, 8)
+    # terminal states exist (committed/aborted outcomes): with deadlock
+    # checking on, TLC-style, the run reports them
+    r2 = bfs(model.system, model.invariants, check_deadlock=True)
+    assert r2.violations and r2.violations[0][0] == "deadlock"
+
+
+def test_device_matches_oracle(model):
+    ro = bfs(model.system, model.invariants, check_deadlock=False)
+    rd = check_struct(model, chunk=64, queue_capacity=512,
+                      fp_capacity=4096, check_deadlock=False)
+    assert rd.violation == 0
+    assert (rd.generated, rd.distinct, rd.depth) == (
+        ro.generated, ro.distinct, ro.depth,
+    )
+    assert rd.action_generated == ro.action_generated
+
+
+def test_broken_tm_violates_agreement(tmp_path):
+    """Drop the unanimity guard from Decide: a TM that commits without
+    all votes lets a prepared RM commit beside a reneged one - the
+    classic split verdict, caught by Agreement with a real trace."""
+    src = open(TLA).read().replace(
+        "/\\ tmPrepared = RM\n", "", 1
+    )
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "TwoPhase.tla").write_text(src)
+    (d / "TwoPhase.cfg").write_text(open(CFG).read())
+    m = load(str(d / "TwoPhase.cfg"))
+    rd = check_struct(m, chunk=64, queue_capacity=512,
+                      fp_capacity=4096, check_deadlock=False)
+    assert rd.violation >= 100
+    assert "Agreement" in rd.violation_name or "CommitVoted" in \
+        rd.violation_name
+    found = violation_trace(m.system, m.invariants, check_deadlock=False)
+    kind, chain = found
+    assert kind in ("Agreement", "CommitVoted")
+    assert chain[0][1] is None
+    assert len(chain) >= 2
+    # the final state genuinely violates the reported invariant
+    bad = chain[-1][0]
+    env = dict(m.system.ev.constants)
+    env.update(zip(m.system.variables, bad))
+    assert m.system.ev.eval(m.invariants[kind], env) is False
+
+
+@pytest.mark.slow
+def test_cli_end_to_end():
+    proc = subprocess.run(
+        [sys.executable, "-m", "jaxtlc.cli", "check", CFG,
+         "-workers", "cpu", "-nodeadlock", "-chunk", "64",
+         "-qcap", "512", "-fpcap", "4096"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "114 states generated, 56 distinct states found" \
+        in proc.stdout
