@@ -290,7 +290,13 @@ def _sany_inputs(cfg_path: str, spec_name: str):
 
 
 def _run_check_gen(args, spec) -> int:
-    """Check a generic-frontend spec (E1): device engine + host liveness."""
+    """Check a generic-frontend spec (E1): device engine + host liveness.
+
+    -sharded runs the gen lane kernel through the mesh engine (the same
+    fp-space partition + all_to_all routing as the KubeAPI path);
+    -checkpoint/-recover snapshot the whole sharded carry (a 1-device
+    mesh when -sharded is not given), mirroring TLC applying its
+    distribution/checkpoint machinery to any spec."""
     from .gen import oracle as go
     from .gen.engine import check_gen
 
@@ -300,17 +306,59 @@ def _run_check_gen(args, spec) -> int:
         for name, (p_ast, q_ast) in g.properties.items():
             yield name, p_ast, q_ast, None
 
-    kit = _InterpKit(
-        kind="generic",
-        extra_unsupported=(),
-        check=lambda: check_gen(
-            g,
+    def check():
+        if not (args.sharded or args.checkpoint):
+            return check_gen(
+                g,
+                chunk=args.chunk,
+                queue_capacity=args.qcap,
+                fp_capacity=args.fpcap,
+                fp_index=spec.fp_index,
+                check_deadlock=spec.check_deadlock,
+            )
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from .engine.sharded import (
+            check_sharded,
+            check_sharded_with_checkpoints,
+            gen_backend,
+        )
+
+        n_dev = args.sharded or 1
+        mesh = Mesh(np.array(jax.devices()[:n_dev]), ("fp",))
+        backend = gen_backend(g)
+        kw = dict(
             chunk=args.chunk,
             queue_capacity=args.qcap,
             fp_capacity=args.fpcap,
-            fp_index=spec.fp_index,
-            check_deadlock=spec.check_deadlock,
+            route_factor=args.routefactor,
+            backend=backend,
+        )
+        if args.checkpoint:
+            meta_config = {
+                "spec": spec.spec_name,
+                "constants": {
+                    k: sorted(v) if isinstance(v, frozenset) else v
+                    for k, v in g.constants.items()
+                },
+            }
+            return check_sharded_with_checkpoints(
+                None, mesh, ckpt_path=args.checkpoint,
+                ckpt_every=args.checkpointevery, resume=args.recover,
+                meta_config=meta_config, **kw,
+            )
+        return check_sharded(None, mesh, **kw)
+
+    kit = _InterpKit(
+        kind="generic",
+        extra_unsupported=(
+            ("-nodeadlock with -sharded/-checkpoint",
+             (args.sharded or args.checkpoint)
+             and not spec.check_deadlock),
         ),
+        check=check,
         init_count=lambda: 1,
         properties=props,
         check_leads_to=lambda name, p, q: go.check_leads_to(
@@ -322,8 +370,26 @@ def _run_check_gen(args, spec) -> int:
         violation_trace=lambda: go.violation_trace(
             g, check_deadlock=spec.check_deadlock
         ),
+        coverage=lambda: _gen_coverage_lines(spec, g),
     )
     return _run_check_interp(args, spec, kit)
+
+
+def _gen_coverage_lines(spec, g):
+    from .gen.coverage import coverage_walk, render_coverage
+
+    text = ""
+    if spec.tla_path:
+        try:
+            with open(spec.tla_path) as f:
+                text = f.read()
+        except OSError:
+            pass
+    init_count, cov = coverage_walk(g, text)
+    return render_coverage(
+        spec.spec_name, init_count, cov,
+        time.strftime("%Y-%m-%d %H:%M:%S"),
+    )
 
 
 def _run_check_struct(args, spec) -> int:
@@ -349,9 +415,15 @@ def _run_check_struct(args, spec) -> int:
 
     kit = _InterpKit(
         kind="structural",
-        # the structural liveness graph is wf_next-only so far
+        # the structural liveness graph is wf_next-only so far; the
+        # mesh/checkpoint engines take the gen-kernel seam, which the
+        # struct compiler does not feed yet
         extra_unsupported=(
             ("-fairness wf_process", args.fairness == "wf_process"),
+            ("-sharded", args.sharded),
+            ("-checkpoint", args.checkpoint),
+            ("-recover", args.recover),
+            ("-coverage", args.coverage),
         ),
         check=lambda: check_struct(
             sm,
@@ -384,7 +456,8 @@ class _InterpKit:
 
     def __init__(self, kind, extra_unsupported, check, init_count,
                  properties, check_leads_to, fairness_label,
-                 state_to_tla, state_env, violation_trace):
+                 state_to_tla, state_env, violation_trace,
+                 coverage=None):
         self.kind = kind
         self.extra_unsupported = extra_unsupported
         self.check = check
@@ -395,6 +468,7 @@ class _InterpKit:
         self.state_to_tla = state_to_tla
         self.state_env = state_env
         self.violation_trace = violation_trace
+        self.coverage = coverage  # () -> dump lines, or None
 
 
 def _run_check_interp(args, spec, kit: "_InterpKit") -> int:
@@ -404,12 +478,8 @@ def _run_check_interp(args, spec, kit: "_InterpKit") -> int:
     interpreter for the trace.  TLC log protocol + exit conventions."""
     unsupported = [
         flag for flag, on in (
-            ("-sharded", args.sharded),
-            ("-checkpoint", args.checkpoint),
-            ("-recover", args.recover),
             ("-fpset DiskFPSet", args.fpset != "JaxFPSet"),
             ("-mutation", args.mutation),
-            ("-coverage", args.coverage),
             *kit.extra_unsupported,
         ) if on
     ]
@@ -501,8 +571,15 @@ def _run_check_interp(args, spec, kit: "_InterpKit") -> int:
                 log.msg(2217, head + "\n" + text, severity=1)
     elif not liveness_violated:
         log.success(r.generated, r.distinct, None)
-        log.coverage_generic(spec.spec_name, n_init,
-                             r.action_generated, r.action_distinct)
+        if args.coverage and kit.coverage is not None:
+            # full per-expression dump: host re-walk with instrumented
+            # evaluation, the KubeAPI path's discipline applied to the
+            # generic frontend (slow for large configs, like TLC's own
+            # coverage mode)
+            log.coverage_gen_dump(kit.coverage())
+        else:
+            log.coverage_generic(spec.spec_name, n_init,
+                                 r.action_generated, r.action_distinct)
     log.progress(r.depth, r.generated, r.distinct, r.queue_left)
     log.final_counts(r.generated, r.distinct, r.queue_left)
     log.depth(r.depth)

@@ -44,14 +44,17 @@ from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
 FORMAT_VERSION = 2
 
 
-def _meta(cfg: ModelConfig, **engine_params) -> dict:
+def _meta(cfg: ModelConfig, meta_config: dict = None,
+          **engine_params) -> dict:
     # round-trip through JSON so tuple-vs-list differences can't make a
-    # fresh meta compare unequal to one loaded from disk
+    # fresh meta compare unequal to one loaded from disk; generic specs
+    # pass a meta_config dict instead of a ModelConfig
     return json.loads(
         json.dumps(
             {
                 "format": FORMAT_VERSION,
-                "config": dataclasses.asdict(cfg),
+                "config": (meta_config if meta_config is not None
+                           else dataclasses.asdict(cfg)),
                 **engine_params,
             }
         )

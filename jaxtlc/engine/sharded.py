@@ -58,6 +58,79 @@ from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED, fp64_words
 from .fpset import FPSet, fpset_insert, host_insert
 
 
+class SpecBackend(NamedTuple):
+    """Everything the sharded engine needs from a spec frontend - the
+    hand-tuned KubeAPI pieces and the generic compiled lanes plug in
+    through the same seam, so distribution is spec-agnostic (TLC's
+    distributed mode works on any spec; launch:4-7)."""
+
+    cdc: object  # pack/unpack/n_fields/nbits
+    step: object  # [F] -> (succ [L,F], valid, action, afail, ovf)
+    n_lanes: int
+    inv_check: object  # [F] -> ok_bits int32 (bit k = invariant k holds)
+    inv_codes: tuple  # bit k failing reports this violation code
+    initial_vectors: object  # () -> [n0, F] numpy
+    labels: tuple  # action id -> display name
+    viol_names: dict  # code -> name overrides (VIOLATION_NAMES fallback)
+
+
+def kubeapi_backend(cfg: ModelConfig) -> SpecBackend:
+    cdc = get_codec(cfg)
+    step = make_kernel(cfg)
+    return SpecBackend(
+        cdc=cdc,
+        step=step,
+        n_lanes=step.n_lanes,
+        inv_check=make_invariant_kernel(cfg),
+        inv_codes=(VIOL_TYPEOK, VIOL_ONLYONEVERSION),
+        initial_vectors=lambda: initial_vectors(cfg),
+        labels=LABELS,
+        viol_names={},
+    )
+
+
+def gen_backend(spec) -> SpecBackend:
+    """Generic-frontend backend: the compiled lane kernel + codec feed
+    the same sharded loop (VERDICT r4 item 4: -sharded for gen specs)."""
+    from ..gen.codec import GenCodec
+    from ..gen.engine import VIOL_INVARIANT_BASE
+    from ..gen.kernel import initial_field_vectors, make_gen_kernel
+
+    cdc = GenCodec(spec)
+    ker = make_gen_kernel(spec, cdc)
+    lane_action = jnp.asarray(ker.lane_action, jnp.int32)
+
+    def step(vec):
+        succs, valid, ovf = ker.step(vec)
+        afail = jnp.zeros_like(valid)  # the gen subset has no Assert
+        return succs, valid, lane_action, afail, ovf
+
+    def inv_check(vec):
+        bits = jnp.int32(0)
+        for k, (_, fn) in enumerate(ker.invariants):
+            bits = bits | (fn(vec).astype(jnp.int32) << k)
+        return bits
+
+    inv_names = list(spec.invariants.keys())
+    return SpecBackend(
+        cdc=cdc,
+        step=step,
+        n_lanes=ker.n_lanes,
+        inv_check=inv_check,
+        inv_codes=tuple(
+            VIOL_INVARIANT_BASE + k for k in range(len(inv_names))
+        ),
+        initial_vectors=lambda: np.asarray(
+            initial_field_vectors(spec, cdc)
+        ),
+        labels=tuple(a.name for a in spec.actions),
+        viol_names={
+            VIOL_INVARIANT_BASE + k: f"Invariant {n} is violated"
+            for k, n in enumerate(inv_names)
+        },
+    )
+
+
 class ShardCarry(NamedTuple):
     """Per-device state; every leaf's leading axis is the mesh axis."""
 
@@ -89,6 +162,7 @@ def make_sharded_engine(
     seed: int = DEFAULT_SEED,
     route_factor: float = 2.0,
     segment: int = 0,
+    backend: SpecBackend = None,
 ):
     """Build (init_fn, run_fn) over `mesh` (single axis named "fp").
 
@@ -110,12 +184,14 @@ def make_sharded_engine(
     (axis,) = mesh.axis_names
     D = mesh.devices.size
     assert D & (D - 1) == 0, "device count must be a power of two"
-    cdc = get_codec(cfg)
+    if backend is None:
+        backend = kubeapi_backend(cfg)
+    cdc = backend.cdc
     F = cdc.n_fields
-    step = make_kernel(cfg)
-    L = step.n_lanes
-    inv_check = make_invariant_kernel(cfg)
-    n_labels = len(LABELS)
+    step = backend.step
+    L = backend.n_lanes
+    inv_check = backend.inv_check
+    n_labels = len(backend.labels)
     nbits = cdc.nbits
     qcap = queue_capacity
     ncand = chunk * L
@@ -129,7 +205,7 @@ def make_sharded_engine(
     # ---------------- init ------------------------------------------------
 
     def init_fn() -> ShardCarry:
-        inits = initial_vectors(cfg)  # [n0, F] numpy
+        inits = backend.initial_vectors()  # [n0, F] numpy
         packed = cdc.pack(jnp.asarray(inits))
         lo, hi = fp64_words(packed, nbits, fp_index, seed)
         own = np.asarray(owner_of(hi))
@@ -205,8 +281,10 @@ def make_sharded_engine(
         faction = action.reshape(-1)
 
         inv = jax.vmap(inv_check)(flat)
-        bad_type = fvalid & ((inv & 1) == 0)
-        bad_oov = fvalid & ((inv & 2) == 0)
+        inv_bad = [
+            fvalid & ((inv & (1 << k)) == 0)
+            for k in range(len(backend.inv_codes))
+        ]
 
         packed = cdc.pack(flat)
         lo, hi = fp64_words(packed, nbits, fp_index, seed)
@@ -292,8 +370,7 @@ def make_sharded_engine(
         new_viol = jnp.int32(OK)
         new_vstate = viol_state
         for code, vmask, states in (
-            (VIOL_TYPEOK, bad_type, flat),
-            (VIOL_ONLYONEVERSION, bad_oov, flat),
+            *((c, b, flat) for c, b in zip(backend.inv_codes, inv_bad)),
             (VIOL_ASSERT, afail.reshape(-1), jnp.repeat(batch, L, axis=0)),
             (VIOL_DEADLOCK, dead, batch),
             (VIOL_SLOT_OVERFLOW, ovf.reshape(-1), jnp.repeat(batch, L, axis=0)),
@@ -389,31 +466,35 @@ def make_sharded_engine(
 
 
 def result_from_shard_carry(
-    out: ShardCarry, wall: float, iterations: int = -1
+    out: ShardCarry, wall: float, iterations: int = -1,
+    labels: tuple = LABELS, viol_names: dict = None,
 ) -> CheckResult:
     """Globally-reduced statistics from a (finished or paused) carry."""
-    act_gen = np.asarray(out.act_gen).sum(axis=0)[: len(LABELS)]
-    act_dist = np.asarray(out.act_dist).sum(axis=0)[: len(LABELS)]
+    act_gen = np.asarray(out.act_gen).sum(axis=0)[: len(labels)]
+    act_dist = np.asarray(out.act_dist).sum(axis=0)[: len(labels)]
     hist = np.asarray(out.outdeg_hist).sum(axis=0)[:-1].astype(np.int64)
     viol = int(np.asarray(out.viol).max())
     vstate = np.zeros(out.viol_state.shape[-1], np.int32)
     vl = np.asarray(out.viol_local)
     if vl.any():
         vstate = np.asarray(out.viol_state)[np.argmax(vl)]
+    vname = (viol_names or {}).get(viol) or VIOLATION_NAMES.get(
+        viol, f"violation {viol}"
+    )
     return CheckResult(
         generated=int(np.asarray(out.generated).sum()),
         distinct=int(np.asarray(out.distinct).sum()),
         depth=int(np.asarray(out.depth).max()),
         queue_left=int((np.asarray(out.qtail) - np.asarray(out.qhead)).sum()),
         violation=viol,
-        violation_name=VIOLATION_NAMES[viol],
+        violation_name=vname,
         violation_state=vstate,
         violation_action=-1,
         action_generated={
-            LABELS[i]: int(v) for i, v in enumerate(act_gen) if v
+            labels[i]: int(v) for i, v in enumerate(act_gen) if v
         },
         action_distinct={
-            LABELS[i]: int(v) for i, v in enumerate(act_dist) if v
+            labels[i]: int(v) for i, v in enumerate(act_dist) if v
         },
         wall_s=wall,
         iterations=iterations,
@@ -428,21 +509,26 @@ def check_sharded(
     queue_capacity: int = 1 << 14,
     fp_capacity: int = 1 << 18,
     route_factor: float = 2.0,
+    backend: SpecBackend = None,
 ) -> CheckResult:
     """Exhaustive sharded check; returns globally-reduced statistics.
 
     The fused loop is AOT-compiled before the timer starts, matching the
     single-device engine's timing discipline (bfs.check)."""
+    if backend is None:
+        backend = kubeapi_backend(cfg)
     init_fn, run_fn = make_sharded_engine(
         cfg, mesh, chunk, queue_capacity, fp_capacity,
-        route_factor=route_factor,
+        route_factor=route_factor, backend=backend,
     )
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()
     t0 = time.time()
     out = jax.block_until_ready(compiled(carry))
     wall = time.time() - t0
-    return result_from_shard_carry(out, wall)
+    return result_from_shard_carry(
+        out, wall, labels=backend.labels, viol_names=backend.viol_names
+    )
 
 
 def check_sharded_with_checkpoints(
@@ -456,6 +542,8 @@ def check_sharded_with_checkpoints(
     ckpt_every: int = 256,
     resume: bool = False,
     max_segments: int = None,
+    backend: SpecBackend = None,
+    meta_config: dict = None,
 ) -> CheckResult:
     """Sharded check with periodic whole-carry checkpoints (TLC checkpoint
     analog under distribution: one snapshot covers every shard's partition
@@ -465,12 +553,15 @@ def check_sharded_with_checkpoints(
 
     from .checkpoint import _meta, load_checkpoint, save_checkpoint
 
+    if backend is None:
+        backend = kubeapi_backend(cfg)
     init_fn, seg_fn = make_sharded_engine(
         cfg, mesh, chunk, queue_capacity, fp_capacity,
-        route_factor=route_factor, segment=ckpt_every,
+        route_factor=route_factor, segment=ckpt_every, backend=backend,
     )
     meta = _meta(
         cfg,
+        meta_config=meta_config,
         queue_capacity=queue_capacity,
         fp_capacity=fp_capacity,
         devices=int(mesh.devices.size),
@@ -501,5 +592,6 @@ def check_sharded_with_checkpoints(
         if ckpt_path is not None:
             save_checkpoint(ckpt_path, carry, meta)
     return result_from_shard_carry(
-        carry, time.time() - t0, iterations=segments
+        carry, time.time() - t0, iterations=segments,
+        labels=backend.labels, viol_names=backend.viol_names,
     )

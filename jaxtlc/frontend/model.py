@@ -58,6 +58,7 @@ class GenRunSpec:
     fp_index: int
     spec_name: str
     model_name: str
+    tla_path: str = ""  # module source (coverage line numbers)
 
 
 @dataclasses.dataclass
@@ -199,6 +200,7 @@ def resolve(
             fp_index=DEFAULT_FP_INDEX if fp_index is None else fp_index,
             spec_name=spec_name,
             model_name=os.path.basename(model_dir),
+            tla_path=tla_path,
         )
     if cfg.specification not in (None, "Spec"):
         raise ValueError(f"unsupported SPECIFICATION {cfg.specification!r}")

@@ -92,3 +92,19 @@ def test_graft_entry_single_step():
     # level 2 with the successors enqueued
     assert int(out.level) == 2 and int(out.level_n) > 0
     assert int(out.generated) > 2
+
+
+@pytest.mark.slow
+def test_sharded_scaled_2x0_tt_exact():
+    """Sharded x scaled composition stays green per-commit (VERDICT r4
+    item 9): the 2-reconciler/0-binder TT config on the 8-device mesh
+    must land on the cross-engine pinned counts (SCALED_VALIDATION.json
+    run set; test_scaled.py pins the same numbers single-device)."""
+    from jaxtlc.config import make_scaled
+
+    r = check_sharded(
+        make_scaled(2, 0, True, True), _mesh(8),
+        chunk=1024, queue_capacity=1 << 14, fp_capacity=1 << 17,
+    )
+    assert (r.generated, r.distinct, r.depth) == (156496, 42849, 67)
+    assert r.queue_left == 0 and r.violation == 0
