@@ -88,9 +88,65 @@ def _probe_backend(attempts: int = 2, hang_timeout_s: int = 120) -> str:
     return err
 
 
+def bench_liveness(probe_err: str) -> int:
+    """--liveness: benchmark the device-resident liveness subsystem.
+
+    Captures the edge relation on device, runs the tensorized survive-set
+    fixpoint for both reference temporal properties, cross-checks the
+    verdicts (both are genuinely VIOLATED - a wrong verdict reports
+    failure, not a rate), and emits edges-captured/s as the metric line.
+    Model_1 on the TPU; the FF fault-injection corner on the CPU fallback
+    (Model_1 liveness takes minutes on one CPU core)."""
+    device_note = ""
+    if probe_err:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        device_note = f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
+    import jax
+
+    from jaxtlc.config import MATRIX, MODEL_1
+    from jaxtlc.live.check import capture_kube_graph, check_properties_device
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    cfg = MATRIX[(False, False)] if on_cpu else MODEL_1
+    workload = "Model_1_FF" if on_cpu else "Model_1"
+    sizing = dict(chunk=256 if on_cpu else 1024,
+                  state_capacity=1 << 14 if on_cpu else 1 << 18,
+                  fp_capacity=1 << 14 if on_cpu else 1 << 18)
+    t0 = time.time()
+    graph = capture_kube_graph(cfg, **sizing)
+    capture_wall = time.time() - t0
+    results = check_properties_device(
+        cfg, ["ReconcileCompletes", "CleansUpProperly"],
+        graph=graph, **sizing,
+    )
+    wall = time.time() - t0
+    if any(r.holds for r in results):
+        _emit({"error": "liveness verdict mismatch (both properties are "
+                        "violated)", "workload": workload})
+        return 1
+    rate = len(graph.src) / capture_wall
+    _emit(
+        {
+            "metric": "liveness_edges_per_s",
+            "value": round(rate, 1),
+            "unit": "edges/s",
+            "workload": workload,
+            "states": graph.n_states,
+            "edges": int(len(graph.src)),
+            "wall_s": round(wall, 3),
+            "device": str(jax.devices()[0]) + device_note,
+        }
+    )
+    return 0
+
+
 def main() -> int:
     device_note = ""
     probe_err = _probe_backend()
+    if "--liveness" in sys.argv:
+        return bench_liveness(probe_err)
     if "--scaled" in sys.argv:
         scaled = True
     elif "--model1" in sys.argv:

@@ -172,16 +172,40 @@ def _run_check(args) -> int:
     violated = r.violation != 0
     liveness_violated = False
     if not violated and (args.liveness or spec.properties):
-        from .engine.liveness import build_graph, check_properties
+        from .live.check import check_properties_device, use_device_path
         from .spec.codec import get_codec
         from .spec.pretty import state_to_tla
 
         props = spec.properties or ["ReconcileCompletes", "CleansUpProperly"]
-        graph = build_graph(spec.model, chunk=args.chunk)
-        results = check_properties(
-            spec.model, props, graph=graph,
-            fairness=args.fairness,
+        device_path = use_device_path(
+            r.distinct, args.fairness, args.liveness_host
         )
+        log.checking_temporal(
+            r.distinct, "device" if device_path else "host"
+        )
+        if device_path:
+            mesh = None
+            if args.sharded:
+                from jax.sharding import Mesh
+
+                import numpy as np
+
+                mesh = Mesh(np.array(jax.devices()[: args.sharded]),
+                            ("fp",))
+            results = check_properties_device(
+                spec.model, props, chunk=args.chunk,
+                state_capacity=args.fpcap, fp_capacity=args.fpcap,
+                mesh=mesh,
+                spill_path=args.checkpoint or None,
+            )
+        else:
+            from .engine.liveness import build_graph, check_properties
+
+            graph = build_graph(spec.model, chunk=args.chunk)
+            results = check_properties(
+                spec.model, props, graph=graph,
+                fairness=args.fairness,
+            )
         decode = get_codec(spec.model).decode
         for res in results:
             if res.holds:
@@ -351,6 +375,25 @@ def _run_check_gen(args, spec) -> int:
             )
         return check_sharded(None, mesh, **kw)
 
+    def leads_to(name, p, q, distinct=0):
+        from .live.check import check_leads_to_device, use_device_path
+
+        if use_device_path(distinct, args.fairness, args.liveness_host):
+            mesh = None
+            if args.sharded:
+                import jax
+                import numpy as np
+                from jax.sharding import Mesh
+
+                mesh = Mesh(np.array(jax.devices()[: args.sharded]),
+                            ("fp",))
+            return check_leads_to_device(
+                g, p, q, name, chunk=args.chunk,
+                state_capacity=args.fpcap, fp_capacity=args.fpcap,
+                mesh=mesh, spill_path=args.checkpoint or None,
+            )
+        return go.check_leads_to(g, p, q, name, fairness=args.fairness)
+
     kit = _InterpKit(
         kind="generic",
         extra_unsupported=(
@@ -361,9 +404,7 @@ def _run_check_gen(args, spec) -> int:
         check=check,
         init_count=lambda: 1,
         properties=props,
-        check_leads_to=lambda name, p, q: go.check_leads_to(
-            g, p, q, name, fairness=args.fairness
-        ),
+        check_leads_to=leads_to,
         fairness_label=args.fairness,
         state_to_tla=lambda st: go.state_to_tla(g, st),
         state_env=lambda st: go.state_env(g, st),
@@ -437,7 +478,7 @@ def _run_check_struct(args, spec) -> int:
         # not run when the flags are about to be rejected
         init_count=lambda: len(system.initial_states()),
         properties=props,
-        check_leads_to=lambda name, p, q: so.check_leads_to(
+        check_leads_to=lambda name, p, q, **_kw: so.check_leads_to(
             system, p, q, name
         ),
         fairness_label="wf_next",
@@ -506,12 +547,21 @@ def _run_check_interp(args, spec, kit: "_InterpKit") -> int:
     violated = r.violation != 0
     liveness_violated = False
     if not violated and spec.properties:
+        from .live.check import use_device_path
+
+        log.checking_temporal(
+            r.distinct,
+            "device" if kit.kind == "generic" and use_device_path(
+                r.distinct, args.fairness, args.liveness_host
+            ) else "host",
+        )
         for name, p_ast, q_ast, skip in kit.properties():
             if skip is not None:
                 log.msg(1000, f"Temporal property {name} skipped: "
                               f"{skip}.", severity=1)
                 continue
-            res = kit.check_leads_to(name, p_ast, q_ast)
+            res = kit.check_leads_to(name, p_ast, q_ast,
+                                     distinct=r.distinct)
             if res.holds:
                 log.msg(1000, f"Temporal property {name} holds "
                               f"(fairness: {kit.fairness_label}).")
@@ -666,11 +716,24 @@ def main(argv=None) -> int:
                         "(TLC coverage mode; re-walks the space host-side)")
     c.add_argument("-liveness", action="store_true",
                    help="check the declared temporal properties even when "
-                        "the launch config disables them (E8)")
+                        "the launch config disables them (E8); above "
+                        "the host-path size threshold the device-resident "
+                        "liveness engine (edge capture + tensorized "
+                        "fixpoint) is picked automatically")
+    c.add_argument("-liveness-host", action="store_true",
+                   dest="liveness_host",
+                   help="force the host-resident liveness path (explicit "
+                        "graph construction) regardless of state count")
     c.add_argument("-fairness", default="wf_next",
                    choices=["wf_next", "wf_process"],
                    help="wf_next = the spec's literal WF_vars(Next); "
-                        "wf_process = per-process weak fairness")
+                        "wf_process = per-process weak fairness.  The "
+                        "fairness unit of wf_process is BY CONVENTION the "
+                        "FIRST bound parameter of each action (e.g. "
+                        "RequestVote(self, voter) is weakly fair per "
+                        "`self`); specs whose actions bind a non-process "
+                        "value first get a wrong partition - reorder the "
+                        "parameters or stay with wf_next")
     c.add_argument("-nodeadlock", action="store_true")
     c.add_argument("-noTool", action="store_true",
                    help="plain text output (no @!@!@ framing)")

@@ -473,6 +473,151 @@ def check(
     return result_from_carry(carry, wall)._replace(actual_fp_collision=afc)
 
 
+class EnumCarry(NamedTuple):
+    """Carry of the fused state enumerator (liveness edge-capture pass 1).
+
+    Unlike EngineCarry's ping-pong level buffers, `states` is APPEND-ONLY:
+    a state's row index is its permanent id (BFS append order), which is
+    exactly what the device-resident liveness subsystem (jaxtlc.live)
+    needs - the edge relation is expressed over these ids."""
+
+    fps: tuple  # fpset.FPSet
+    states: jnp.ndarray  # [cap + A, W] uint32 packed states, id = row
+    head: jnp.ndarray  # int32: next id to expand
+    tail: jnp.ndarray  # int32: number of distinct states stored
+    viol: jnp.ndarray  # int32: OK or a capacity/overflow code
+
+
+def make_enumerator(
+    backend,
+    chunk: int = 1024,
+    state_capacity: int = 1 << 20,
+    fp_capacity: int = 1 << 20,
+    fp_index: int = DEFAULT_FP_INDEX,
+    seed: int = DEFAULT_SEED,
+):
+    """Build (init_fn, run_fn) for the fused distinct-state enumerator.
+
+    The optional capture mode of the BFS core: the same vmapped kernel +
+    MXU fingerprints + sort-compacted dedup as the exhaustive engine, but
+    the frontier is the append-only `states` array itself (a work-list
+    pop cursor instead of level fencing), so after one fused
+    `lax.while_loop` the whole reachable set sits on device in id order.
+    `backend` is any engine.sharded.SpecBackend (kubeapi_backend /
+    gen_backend), so every frontend that can run sharded can be
+    enumerated - the seam the liveness capture (jaxtlc.live.capture)
+    feeds on.
+
+    Halts loudly with VIOL_QUEUE_FULL when `state_capacity` is exceeded
+    (the caller's cue to raise it or spill), VIOL_FPSET_FULL /
+    VIOL_SLOT_OVERFLOW as in the exhaustive engine.
+    """
+    cdc = backend.cdc
+    F = cdc.n_fields
+    W = (cdc.nbits + 31) // 32
+    step = backend.step
+    L = backend.n_lanes
+    nbits = cdc.nbits
+    cap = state_capacity
+    ncand = chunk * L
+    R = min(2 * chunk, ncand)
+    A = min(2 * chunk, ncand)
+
+    def init_fn() -> EnumCarry:
+        inits = jnp.asarray(backend.initial_vectors())
+        n0 = inits.shape[0]
+        assert n0 <= chunk and n0 <= cap, "raise chunk/state_capacity"
+        packed0 = cdc.pack(inits)
+        states = jnp.zeros((cap + A, W), jnp.uint32).at[:n0].set(packed0)
+        lo, hi = fp64_words_mxu(packed0, nbits, fp_index, seed)
+        fps, _, _, _ = fpset_insert_sorted(
+            fpset_new(fp_capacity), lo, hi, jnp.ones(n0, bool)
+        )
+        return EnumCarry(
+            fps=fps,
+            states=states,
+            head=jnp.int32(0),
+            tail=jnp.int32(n0),
+            viol=jnp.int32(OK),
+        )
+
+    def body(c: EnumCarry) -> EnumCarry:
+        avail = c.tail - c.head
+        n = jnp.minimum(chunk, avail)
+        rows = jnp.arange(chunk, dtype=jnp.int32)
+        mask = rows < n
+
+        block = lax.dynamic_slice(
+            c.states, (c.head, jnp.int32(0)), (chunk, W)
+        )
+        batch = cdc.unpack(block)
+        succs, valid, _action, _afail, ovf = jax.vmap(step)(batch)
+        valid = valid & mask[:, None]
+        ovf = ovf & valid
+
+        flat = succs.reshape(ncand, F)
+        fvalid = valid.reshape(-1)
+        packed = cdc.pack(flat)
+        lo, hi = fp64_words_mxu(packed, nbits, fp_index, seed)
+
+        fp_full = (c.tail + ncand) > int(fp_capacity * 0.85)
+        fps, is_new_c, c_idx, _ = fpset_insert_sorted(
+            c.fps, lo, hi, fvalid & ~fp_full, probe_width=R, claim_width=R
+        )
+        n_new = is_new_c.sum().astype(jnp.int32)
+        s_full = c.tail + n_new > cap
+
+        # append new states at the tail in candidate order (the engines'
+        # sort-compact + A-wide contiguous-write pattern)
+        _, e_idx = lax.sort(
+            ((~is_new_c).astype(jnp.uint32), c_idx.astype(jnp.uint32)),
+            num_keys=2,
+            is_stable=True,
+        )
+        e_idx_p = jnp.concatenate([e_idx, jnp.zeros(A, jnp.uint32)])
+
+        def enq_cond(st):
+            _, s = st
+            return s * A < n_new
+
+        def enq_body(st):
+            states, s = st
+            offs = s * A
+            idx_a = lax.dynamic_slice(e_idx_p, (offs,), (A,)).astype(
+                jnp.int32
+            )
+            rows_a = packed[idx_a]
+            woff = jnp.minimum(c.tail + offs, cap)
+            states = lax.dynamic_update_slice(
+                states, rows_a, (woff, jnp.int32(0))
+            )
+            return states, s + 1
+
+        states, _ = lax.while_loop(
+            enq_cond, enq_body, (c.states, jnp.int32(0))
+        )
+
+        viol = c.viol
+        viol = jnp.where(ovf.any() & (viol == OK), VIOL_SLOT_OVERFLOW, viol)
+        viol = jnp.where(
+            fp_full & fvalid.any() & (viol == OK), VIOL_FPSET_FULL, viol
+        )
+        viol = jnp.where(s_full & (viol == OK), VIOL_QUEUE_FULL, viol)
+        tail = jnp.where(s_full, c.tail, c.tail + n_new)
+        return EnumCarry(
+            fps=fps, states=states, head=c.head + n, tail=tail, viol=viol
+        )
+
+    def cond(c: EnumCarry):
+        return (c.head < c.tail) & (c.viol == OK)
+
+    @jax.jit
+    def run_fn(c: EnumCarry) -> EnumCarry:
+        return lax.while_loop(cond, body, c)
+
+    return init_fn, run_fn
+
+
 def outdegree_from_hist(hist: np.ndarray):
     """(avg, min, max, p95) of TLC's outdegree from a new-children
     histogram (hist[d] = #expanded states with d new successors); None if

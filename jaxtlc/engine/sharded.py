@@ -32,8 +32,31 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # pragma: no cover - older jax keeps it experimental
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+def shard_map(f, mesh, in_specs, out_specs, **kw):
+    """Version-portable shard_map: the replication-check kwarg was renamed
+    check_rep -> check_vma across jax releases; accept either here so the
+    engine runs on both the TPU driver's jax and the pinned CPU test jax."""
+    try:
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    except TypeError:
+        kw2 = dict(kw)
+        if "check_vma" in kw2:
+            kw2["check_rep"] = kw2.pop("check_vma")
+        elif "check_rep" in kw2:
+            kw2["check_vma"] = kw2.pop("check_rep")
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw2
+        )
 
 from ..config import ModelConfig
 from ..spec.codec import get_codec
@@ -500,6 +523,76 @@ def result_from_shard_carry(
         iterations=iterations,
         outdegree=outdegree_from_hist(hist),
     )
+
+
+def sharded_survive_fixpoint(
+    mesh: Mesh,
+    n_states: int,
+    src: np.ndarray,
+    dst: np.ndarray,
+    in_h: np.ndarray,
+    terminal: np.ndarray,
+):
+    """Mesh-parallel greatest-fixpoint survive sweep for the device
+    liveness subsystem (jaxtlc.live.fixpoint): the EDGE relation is
+    sharded over the mesh axis (each device owns an E/D slice of the
+    captured (src, dst) tensors), the per-state survive bit-plane is
+    replicated, and every sweep reduces the per-device successor-support
+    partials with a psum - the liveness analog of the BFS engine's
+    fingerprint-space partitioning, over the same mesh.
+
+    survive(s) iff s in H and (terminal(s) or some captured state-changing
+    successor of s survives); the whole converging `lax.while_loop` runs
+    inside one shard_map dispatch.  Returns (alive bool [V], sweeps).
+
+    Caller contract: (src, dst) are already restricted to state-changing
+    edges internal to H (jaxtlc.live.fixpoint filters them).
+    """
+    (axis,) = mesh.axis_names
+    D = mesh.devices.size
+    V = int(n_states)
+    E = len(src)
+    Ep = max(-(-max(E, 1) // D) * D, D)
+    # pad with src = V: out of range, dropped by the scatter
+    src_p = np.full(Ep, V, np.int32)
+    dst_p = np.zeros(Ep, np.int32)
+    src_p[:E] = src
+    dst_p[:E] = dst
+
+    def run(src_s, dst_s, in_h_r, term_r):
+        def body(st):
+            alive, _, sweeps = st
+            part = jnp.zeros(V, jnp.int32).at[src_s].max(
+                alive[dst_s].astype(jnp.int32), mode="drop"
+            )
+            support = lax.psum(part, axis) > 0
+            alive2 = alive & (term_r | support)
+            return alive2, (alive2 != alive).any(), sweeps + 1
+
+        return lax.while_loop(
+            lambda st: st[1],
+            body,
+            (in_h_r, jnp.bool_(True), jnp.int32(0)),
+        )
+
+    fn = jax.jit(
+        shard_map(
+            run,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
+    alive, _, sweeps = jax.block_until_ready(
+        fn(
+            jnp.asarray(src_p),
+            jnp.asarray(dst_p),
+            jnp.asarray(in_h, bool),
+            jnp.asarray(terminal, bool),
+        )
+    )
+    return np.asarray(alive), int(sweeps)
 
 
 def check_sharded(

@@ -248,6 +248,19 @@ class TLCLog:
         for ln in lines[1:]:
             self.msg(2772, ln)
 
+    def checking_temporal(self, distinct: int, path: str = "host") -> None:
+        """TLC's 2192 liveness-phase banner ("Checking temporal properties
+        for the complete state space..."), extended with which liveness
+        engine runs: `host` (explicit graph) or `device` (edge capture +
+        tensorized fixpoint)."""
+        self.msg(
+            2192,
+            f"Checking temporal properties for the complete state space "
+            f"with {distinct} total distinct states at "
+            f"{time.strftime('%Y-%m-%d %H:%M:%S')} "
+            f"({path} liveness engine)",
+        )
+
     def final_counts(self, generated: int, distinct: int, queue: int) -> None:
         self.msg(
             2199,

@@ -1,0 +1,237 @@
+"""Device liveness orchestration: capture -> fixpoint -> validated lasso.
+
+Two frontend entry points, both producing the SAME result types their
+host-path counterparts produce, so the CLI rendering is path-agnostic:
+
+* check_properties_device(cfg, props)  - the KubeAPI family
+  (engine.liveness.LivenessResult with encoded field-vector states);
+* check_leads_to_device(genspec, p, q) - generic-frontend specs
+  (gen.oracle.LivenessResult with decoded state tuples).
+
+Semantics are the host path's WF_vars(Next) reduction exactly
+(engine.liveness module docstring); `wf_process` stays host-only - the
+CLI routes it there.  Every violation is oracle-replayed before being
+returned (live.lasso.replay_lasso); the differential tests additionally
+pin whole-verdict and state-set equality against the host engines.
+
+The device path is picked automatically above HOST_PATH_MAX distinct
+states (where the host path's per-state Python dict becomes the
+bottleneck); `-liveness-host` forces the old path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..engine.liveness import LivenessResult as KubeLivenessResult
+from .capture import CapturedGraph, capture_edges, eval_state_masks
+from .fixpoint import has_nonself, surviving_set
+from .lasso import build_lasso, replay_lasso
+
+# above this many distinct states the host liveness graph (one Python
+# dict entry + adjacency list per state) stops being viable; the device
+# path has no per-state host objects at all
+HOST_PATH_MAX = 1_000_000
+
+
+def use_device_path(distinct: int, fairness: str = "wf_next",
+                    force_host: bool = False) -> bool:
+    """CLI dispatch rule: device path automatically above the host-path
+    size threshold; wf_process and -liveness-host stay on the host path."""
+    return (not force_host) and fairness == "wf_next" \
+        and distinct > HOST_PATH_MAX
+
+
+def _violation(graph, alive, in_h, trigger, name, labels,
+               decode, is_initial, is_transition, equal=None):
+    prefix_ids, cycle_ids, pre_act, cyc_act = build_lasso(
+        graph, alive, in_h, trigger
+    )
+    prefix = [decode(i) for i in prefix_ids]
+    cycle = [decode(i) for i in cycle_ids]
+    replay_lasso(prefix, cycle, is_initial, is_transition, equal=equal)
+    names = [None if a is None else labels[a] for a in pre_act]
+    cnames = [None if a is None else labels[a] for a in cyc_act]
+    return prefix_ids, cycle_ids, prefix, cycle, names, cnames
+
+
+# ---------------------------------------------------------------------------
+# KubeAPI family
+# ---------------------------------------------------------------------------
+
+
+def capture_kube_graph(cfg, chunk: int = 1024,
+                       state_capacity: int = 1 << 20,
+                       fp_capacity: int = 1 << 20,
+                       spill_path: Optional[str] = None) -> CapturedGraph:
+    from ..engine.sharded import kubeapi_backend
+
+    return capture_edges(
+        kubeapi_backend(cfg), chunk=chunk, state_capacity=state_capacity,
+        fp_capacity=fp_capacity, spill_path=spill_path,
+    )
+
+
+def check_properties_device(
+    cfg,
+    properties: List[str],
+    chunk: int = 1024,
+    state_capacity: int = 1 << 20,
+    fp_capacity: int = 1 << 20,
+    mesh=None,
+    graph: Optional[CapturedGraph] = None,
+    spill_path: Optional[str] = None,
+) -> List[KubeLivenessResult]:
+    """Device-path analog of engine.liveness.check_properties (wf_next)."""
+    import jax.numpy as jnp
+
+    from ..spec import oracle
+    from ..spec.codec import get_codec
+    from ..spec.labels import LABELS
+
+    cdc = get_codec(cfg)
+    if graph is None:
+        graph = capture_kube_graph(
+            cfg, chunk=chunk, state_capacity=state_capacity,
+            fp_capacity=fp_capacity, spill_path=spill_path,
+        )
+    nonself = has_nonself(graph)
+    sr_off = cdc.offsets["sr"]
+    api_sl = cdc.sl("api")
+
+    def sr_fn(ri):
+        return lambda f: f[:, sr_off + ri] == 1
+
+    def secret_fn(ci):
+        si, _ = cfg.targets[ci]
+
+        def fn(f):
+            api = f[:, api_sl]
+            pres = (api >> cdc.o_present) & 1
+            ident = (api >> cdc.o_ident) & ((1 << cdc.ib) - 1)
+            return ((pres == 1) & (ident == si)).any(axis=1)
+
+        return fn
+
+    def decode_fields(i):
+        row = jnp.asarray(graph.states[i][None])
+        return np.asarray(cdc.unpack(row))[0].astype(np.int32)
+
+    inits = set(oracle.initial_states(cfg))
+
+    def is_initial(enc):
+        return cdc.decode(np.asarray(enc)) in inits
+
+    def is_transition(ea, eb):
+        sa = cdc.decode(np.asarray(ea))
+        sb = cdc.decode(np.asarray(eb))
+        return sb in {x.state for x in oracle.successors(sa, cfg)}
+
+    out: List[KubeLivenessResult] = []
+    for name in properties:
+        if cfg.n_reconcilers == 0:
+            out.append(KubeLivenessResult(name, True, None, None))
+            continue
+        if name == "ReconcileCompletes":
+            zones = [(sr_fn(ri), None) for ri in range(cfg.n_reconcilers)]
+        elif name == "CleansUpProperly":
+            zones = [
+                (sr_fn(k), secret_fn(ci))
+                for k, ci in enumerate(cfg.reconciler_indices)
+            ]
+        else:
+            raise ValueError(f"unknown temporal property {name!r}")
+        res = None
+        for sr, secret in zones:
+            if secret is None:
+                # sr[c] ~> ~sr[c]: H = trigger = {sr[c]}
+                (mask,) = eval_state_masks(graph, cdc, [sr])
+                in_h = trigger = mask
+            else:
+                # []~sr[c] ~> absent: H = trigger = {~sr[c] /\ present}
+                srm, pm = eval_state_masks(graph, cdc, [sr, secret])
+                in_h = trigger = ~srm & pm
+            alive, _ = surviving_set(graph, in_h, mesh=mesh,
+                                     nonself=nonself)
+            bad = trigger & alive
+            if not bad.any():
+                res = KubeLivenessResult(name, True, None, None)
+                continue
+            _, _, prefix, cycle, pnames, cnames = _violation(
+                graph, alive, in_h, bad, name, LABELS,
+                decode_fields, is_initial, is_transition,
+                equal=np.array_equal,
+            )
+            res = KubeLivenessResult(name, False, prefix, cycle,
+                                     pnames, cnames)
+            break
+        out.append(res)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Generic frontend
+# ---------------------------------------------------------------------------
+
+
+def check_leads_to_device(
+    spec,
+    p_ast,
+    q_ast,
+    name: str = "",
+    chunk: int = 1024,
+    state_capacity: int = 1 << 20,
+    fp_capacity: int = 1 << 20,
+    mesh=None,
+    graph: Optional[CapturedGraph] = None,
+    spill_path: Optional[str] = None,
+):
+    """Device-path analog of gen.oracle.check_leads_to (wf_next)."""
+    import jax
+
+    from ..gen import oracle as go
+    from ..gen.kernel import _Ctx, compile_expr
+    from ..engine.sharded import gen_backend
+
+    backend = gen_backend(spec)
+    cdc = backend.cdc
+    if graph is None:
+        graph = capture_edges(
+            backend, chunk=chunk, state_capacity=state_capacity,
+            fp_capacity=fp_capacity, spill_path=spill_path,
+        )
+    ctx = _Ctx(codec=cdc, consts=dict(spec.constants), binding={}, at=None)
+    masks = []
+    for ast in (p_ast, q_ast):
+        kind, fn = compile_expr(ast, ctx)
+        if kind != "bool":
+            raise ValueError(f"property operand is not BOOLEAN: {ast!r}")
+        masks.append(jax.vmap(fn))
+    p_mask, q_mask = eval_state_masks(graph, cdc, masks)
+    in_h = ~q_mask
+    alive, _ = surviving_set(graph, in_h, mesh=mesh)
+    bad = p_mask & alive
+    if not bad.any():
+        return go.LivenessResult(name, True, None, None)
+
+    init = go.initial_state(spec)
+
+    def decode(i):
+        import jax.numpy as jnp
+
+        row = jnp.asarray(graph.states[i][None])
+        return cdc.decode(np.asarray(cdc.unpack(row))[0])
+
+    def is_transition(sa, sb):
+        return any(
+            nxt == sb and changed
+            for _, nxt, changed in go.successors(spec, sa)
+        )
+
+    _, _, prefix, cycle, _, _ = _violation(
+        graph, alive, in_h, bad, name, backend.labels,
+        decode, lambda s: s == init, is_transition,
+    )
+    return go.LivenessResult(name, False, prefix, cycle)

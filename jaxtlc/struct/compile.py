@@ -38,7 +38,7 @@ import numpy as np
 
 from ..spec.labels import DEFAULT_INIT
 from .codec import EnumLeaf, MaskLeaf, RecNode, SeqNode, StructCodec, layout_of
-from .eval import BUILTIN_SETS, Evaluator, is_fn
+from .eval import _SORT_KEY, BUILTIN_SETS, Evaluator, is_fn
 from .parser import Definition
 from .shapes import (
     SAtoms,
@@ -225,6 +225,21 @@ class LaneCompiler:
             self._trans_tables[key] = t
         return t
 
+    def choose_rank_table(self, leaf: EnumLeaf) -> np.ndarray:
+        """rank[i] = position of leaf.values[i] under the evaluator's
+        CHOOSE iteration order (sorted by _SORT_KEY): the device witness
+        pick minimizes this rank so both engines agree."""
+        key = (id(leaf), "#choose_rank")
+        t = self._pred_tables.get(key)
+        if t is None:
+            order = sorted(range(len(leaf.values)),
+                           key=lambda i: _SORT_KEY(leaf.values[i]))
+            t = np.zeros(len(leaf.values), np.int32)
+            for r, i in enumerate(order):
+                t[i] = r
+            self._pred_tables[key] = t
+        return t
+
     def value_pred_table(self, leaf: EnumLeaf, fn) -> np.ndarray:
         key = (id(leaf), fn.__name__, getattr(fn, "_key", None))
         t = self._pred_tables.get(key)
@@ -378,6 +393,24 @@ class LaneCompiler:
                           m.astype(jnp.int32)) > 0
         return LM(bits, elem_leaf, lv.depth)
 
+    def remask_tracked(self, lv: LM, elem_leaf: EnumLeaf):
+        """remask + lane-wise LB flag: a set bit had no image in the new
+        universe (it was DROPPED - membership of it is False by
+        construction, but equality through the reduced planes would lie)."""
+        t = self.trans_table(lv.elem_leaf, elem_leaf)
+        lost = jnp.asarray(t < 0)
+        dropped = LB((lv.bits & lost).any(axis=-1), lv.depth)
+        return self.remask(lv, elem_leaf), dropped
+
+    def _setlit_dropped(self, lit: "LSetLit", elem_leaf: EnumLeaf) -> LV:
+        """Lane-wise LB: some literal item has no index in elem_leaf
+        (to_leaf returned -1), i.e. _setlit_mask dropped it."""
+        dropped = LC(False)
+        for item in lit.items:
+            ie = self.to_leaf(item, elem_leaf)
+            dropped = self._lor(dropped, LB(ie.arr < 0, ie.depth))
+        return dropped
+
     def explode(self, lv: LE) -> LRec:
         """Enum record -> structural record (field gathers)."""
         sh = lv.leaf.shape
@@ -439,11 +472,25 @@ class LaneCompiler:
             return LB(x == y, d)
         if isinstance(a, LM) or isinstance(b, LM):
             am = self.as_mask(a)
+            # a's elements all live in am's universe, so any element of b
+            # DROPPED while expressing it there makes equality impossible:
+            # dropping silently would compare a against b-intersect-universe
+            # and let `s = K` / `s # K` corrupt exploration (ADVICE.md)
+            dropped = LC(False)
+            if isinstance(b, LC):
+                if not isinstance(b.value, frozenset):
+                    raise CompileError(f"not a set constant: {b.value!r}")
+                if any(x not in am.elem_leaf.index for x in b.value):
+                    return LC(False)
+            if isinstance(b, LSetLit):
+                dropped = self._setlit_dropped(b, am.elem_leaf)
             bm = self.as_mask(b, like=am)
             if bm.elem_leaf is not am.elem_leaf:
-                bm = self.remask(bm, am.elem_leaf)
+                bm, rdrop = self.remask_tracked(bm, am.elem_leaf)
+                dropped = self._lor(dropped, rdrop)
             x, y, d = _mask_align(am.bits, am.depth, bm.bits, bm.depth)
-            return LB((x == y).all(axis=-1), d)
+            return self._land(LB((x == y).all(axis=-1), d),
+                              self._lnot(dropped))
         if isinstance(a, LE):
             be = self.to_leaf(b, a.leaf)
             x, y, d = _binop_arrs(a.arr, a.depth, be.arr, be.depth)
@@ -681,7 +728,28 @@ class LaneCompiler:
             for i in range(base.cap - 2, -1, -1):
                 here = self.eq(arg, LC(i + 1))
                 out = self.select(here, base.slots[i], out)
-            return self._from_leaf(out, base.leaf.shape)
+            # an index outside 1..Len(s) must emit the -1 trap (to_leaf's
+            # range-trap discipline) - never the where-chain default slot,
+            # which would be a silently wrong value for a reachable
+            # out-of-bounds read (host evaluator raises here).  The slot
+            # -1 alone is not loud enough: _from_leaf re-bases enum codes
+            # into the ELEM value range, which can land back inside the
+            # destination universe - so the read also registers in
+            # ctx.trap directly (reduced over lift axes; a trap on any
+            # branch of a lifted binder halts, loud beats silent)
+            oe = self.to_leaf(out, base.leaf)
+            av, ad = self._int_arr(arg)
+            lnv, lnd = self._int_arr(base.length)
+            x, y, d0 = _binop_arrs(av, ad, lnv, lnd)
+            okb = (x >= 1) & (x <= y)
+            bad = ~okb
+            for _ in range(d0):
+                bad = bad.any(axis=-1)
+            ctx.trap = self._lor(ctx.trap, LB(bad, 0))
+            oka, oa, d = _binop_arrs(okb.astype(jnp.int32), d0,
+                                     oe.arr, oe.depth)
+            oe = LE(jnp.where(oka == 1, oa, -1), base.leaf, d)
+            return self._from_leaf(oe, base.leaf.shape)
         if not isinstance(arg, LC):
             raise CompileError("dynamic function application index")
         key = arg.value
@@ -1340,7 +1408,13 @@ class LaneCompiler:
             mbits = _mask_align(m.bits, m.depth, barr, level - 1)[0]
             sel = mbits & barr
             depth = level - 1
-        idx = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+        # pick the witness the HOST evaluator picks (eval.py choose: the
+        # _SORT_KEY-least satisfying element), not the first set bit in
+        # universe enumeration order - with a non-unique predicate the two
+        # orders diverge and the engines' state spaces drift apart
+        n = len(m.elem_leaf.values)
+        rank = jnp.asarray(self.choose_rank_table(m.elem_leaf))
+        idx = jnp.argmin(jnp.where(sel, rank, n), axis=-1).astype(jnp.int32)
         ok = sel.any(axis=-1)
         return LE(jnp.where(ok, idx, -1), m.elem_leaf, depth)
 

@@ -67,11 +67,26 @@ BUILTIN_SETS = {
 
 
 def canon(v):
-    """Canonicalize nested containers to the oracle value model."""
+    """Canonicalize nested containers to the oracle value model.
+
+    A tuple of (string, value) pairs reads as a string-keyed function -
+    the only tuple shape the model cannot disambiguate from a sequence
+    of string-first 2-tuples.  Genuine functions are always constructed
+    key-sorted with distinct keys (record literal, _pairs_to_fn, EXCEPT,
+    @@), so a duplicate or out-of-order key proves the value is really a
+    SEQUENCE about to be silently reordered/misrouted: raise loudly
+    instead (ADVICE.md eval.py:75)."""
     if isinstance(v, tuple) and v and all(
         isinstance(x, tuple) and len(x) == 2 and isinstance(x[0], str)
         for x in v
     ):
+        keys = [k for k, _ in v]
+        if len(set(keys)) != len(keys) or keys != sorted(keys):
+            raise StructEvalError(
+                "ambiguous value: a tuple of (string, value) pairs with "
+                "duplicate or unsorted keys is a sequence that would be "
+                f"misread as a string-keyed function: {v!r}"
+            )
         return tuple(sorted((k, canon(x)) for k, x in v))
     if isinstance(v, tuple):
         return tuple(canon(x) for x in v)
