@@ -142,11 +142,83 @@ def bench_liveness(probe_err: str) -> int:
     return 0
 
 
+def bench_resil(probe_err: str) -> int:
+    """--resil: measure the perf cost of robustness.
+
+    Runs a supervised checkpointed run (measuring mean checkpoint-write
+    seconds) and a deliberately undersized run (measuring regrow-migration
+    seconds), gating both on exact expected counts, and emits ONE metric
+    line so BENCH_*.json tracks the overhead of the resil tier."""
+    device_note = ""
+    if probe_err:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        device_note = f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
+    import tempfile
+
+    import jax
+
+    from jaxtlc.config import MATRIX, MODEL_1
+    from jaxtlc.resil import SupervisorOptions, check_supervised
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        cfg, expect = MATRIX[(False, False)], (17020, 8203, 109)
+        kw = dict(chunk=128, queue_capacity=1 << 12, fp_capacity=1 << 14)
+        small = dict(chunk=128, queue_capacity=1 << 12,
+                     fp_capacity=1 << 11)
+        workload = "Model_1_FF"
+    else:
+        cfg, expect = MODEL_1, EXPECT["Model_1"]
+        kw = dict(chunk=1024, queue_capacity=1 << 15, fp_capacity=1 << 20)
+        small = dict(chunk=1024, queue_capacity=1 << 15,
+                     fp_capacity=1 << 17)
+        workload = "Model_1"
+    with tempfile.TemporaryDirectory() as d:
+        sr = check_supervised(
+            cfg, opts=SupervisorOptions(ckpt_path=f"{d}/b.npz",
+                                        ckpt_every=32), **kw,
+        )
+        grown = check_supervised(
+            cfg, opts=SupervisorOptions(ckpt_every=32), **small
+        )
+    for name, run in (("checkpointed", sr), ("regrown", grown)):
+        r = run.result
+        if r.violation or (r.generated, r.distinct, r.depth) != expect:
+            _emit({"error": f"{name} count mismatch: "
+                            f"{(r.generated, r.distinct, r.depth)}",
+                   "workload": workload})
+            return 1
+    if grown.regrows == 0:
+        _emit({"error": "regrow scenario did not regrow",
+               "workload": workload})
+        return 1
+    ckpt_ms = 1000 * sr.ckpt_write_s / max(sr.ckpt_writes, 1)
+    _emit(
+        {
+            "metric": "ckpt_write_ms",
+            "value": round(ckpt_ms, 2),
+            "unit": "ms/checkpoint",
+            "workload": workload,
+            "ckpt_writes": sr.ckpt_writes,
+            "ckpt_write_s_total": round(sr.ckpt_write_s, 3),
+            "regrow_events": grown.regrows,
+            "regrow_migrate_ms": round(1000 * grown.regrow_s, 1),
+            "run_wall_s": round(sr.result.wall_s, 3),
+            "device": str(jax.devices()[0]) + device_note,
+        }
+    )
+    return 0
+
+
 def main() -> int:
     device_note = ""
     probe_err = _probe_backend()
     if "--liveness" in sys.argv:
         return bench_liveness(probe_err)
+    if "--resil" in sys.argv:
+        return bench_resil(probe_err)
     if "--scaled" in sys.argv:
         scaled = True
     elif "--model1" in sys.argv:

@@ -14,7 +14,16 @@ log protocol.  On violation it re-runs in host mode to reconstruct the
 counterexample trace and prints it TLC-style with PlusCal action labels.
 
 Exit codes: 0 = no error; 12 = safety violation (TLC's EC.ExitStatus
-convention for violations); 1 = usage/config error.
+convention for violations); 13 = liveness violation; 75 = interrupted
+(SIGTERM/SIGINT) with a final checkpoint written - resume with -recover;
+1 = usage/config error (including non-regrowable codec slot overflow).
+
+Robustness (the resil supervisor wraps the KubeAPI-path engines):
+-auto-grow (default) doubles a saturated fpset/queue/route resource,
+migrates the carry, and resumes instead of aborting; -retry N retries
+segments around transient device errors; -checkpoint writes CRC-verified
+generation-numbered snapshots and -recover loads the newest intact one
+(auto-grown geometry travels inside the checkpoint).
 """
 
 from __future__ import annotations
@@ -78,89 +87,27 @@ def _run_check(args) -> int:
     log.computing_init()
 
     t0 = time.time()
-    # dispatch priority: DiskFPSet routes to the host tier even when
-    # -sharded is given (sharding then means fingerprint-space partitions)
-    if args.sharded and args.fpset != "DiskFPSet":
-        import numpy as np
-        from jax.sharding import Mesh
+    from .resil import SlotOverflowError
 
-        from .engine.sharded import (
-            check_sharded,
-            check_sharded_with_checkpoints,
-        )
-
-        mesh = Mesh(np.array(jax.devices()[: args.sharded]), ("fp",))
-        if args.checkpoint:
-            r = check_sharded_with_checkpoints(
-                spec.model,
-                mesh,
-                chunk=args.chunk,
-                queue_capacity=args.qcap,
-                fp_capacity=args.fpcap,
-                route_factor=args.routefactor,
-                ckpt_path=args.checkpoint,
-                ckpt_every=args.checkpointevery,
-                resume=args.recover,
-            )
-        else:
-            r = check_sharded(
-                spec.model,
-                mesh,
-                chunk=args.chunk,
-                queue_capacity=args.qcap,
-                fp_capacity=args.fpcap,
-                route_factor=args.routefactor,
-            )
-    elif args.fpset == "DiskFPSet":
-        # the OffHeapDiskFPSet/DiskStateQueue analog: authoritative dedup +
-        # frontier in the native (C++, disk-bounded) host tier.  Composes
-        # with -checkpoint (the disk tier's files ARE the snapshot, as in
-        # TLC) and with -sharded N (N fingerprint-space partitions - the
-        # distributed-fingerprint-server analog, launch:4)
-        from .engine.hybrid import check_hybrid
-
-        nparts = max(args.sharded, 1)
-        if nparts & (nparts - 1):
-            print(
-                "Error: -sharded with -fpset DiskFPSet needs a power-of-"
-                f"two partition count, got {nparts}",
-                file=sys.stderr,
-            )
-            return 1
-        r = check_hybrid(
-            spec.model,
-            chunk=args.chunk,
-            fp_index=spec.fp_index,
-            fp_partitions=nparts,
-            ckpt_path=args.checkpoint or None,
-            ckpt_every=args.checkpointevery,
-            resume=args.recover,
-        )
-    elif args.checkpoint:
-        from .engine.checkpoint import check_with_checkpoints
-
-        r = check_with_checkpoints(
-            spec.model,
-            chunk=args.chunk,
-            queue_capacity=args.qcap,
-            fp_capacity=args.fpcap,
-            fp_index=spec.fp_index,
-            ckpt_path=args.checkpoint,
-            ckpt_every=args.checkpointevery,
-            resume=args.recover,
-            on_progress=log.progress,
-        )
-    else:
-        from .engine.bfs import check
-
-        r = check(
-            spec.model,
-            chunk=args.chunk,
-            queue_capacity=args.qcap,
-            fp_capacity=args.fpcap,
-            fp_index=spec.fp_index,
-        )
+    sup = None  # SupervisedResult when the resil supervisor ran
+    try:
+        r, sup = _dispatch_check(args, spec, log)
+    except SlotOverflowError as e:
+        log.msg(1000, f"Run stopped: {e}", severity=1)
+        return 1
+    except FileNotFoundError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
     log.init_done(2 ** spec.model.n_reconcilers)
+
+    if sup is not None and sup.interrupted:
+        # the interrupted banner (with the resume command) was already
+        # emitted by the supervisor's event hook
+        from .resil import EXIT_INTERRUPTED
+
+        log.progress(r.depth, r.generated, r.distinct, r.queue_left)
+        log.final_counts(r.generated, r.distinct, r.queue_left)
+        return EXIT_INTERRUPTED
 
     from .engine.bfs import (
         VIOL_ASSERT,
@@ -242,7 +189,8 @@ def _run_check(args) -> int:
                      check_deadlock=spec.check_deadlock)
     elif not liveness_violated:
         log.success(r.generated, r.distinct,
-                    getattr(r, "actual_fp_collision", None))
+                    getattr(r, "actual_fp_collision", None),
+                    occupancy=getattr(r, "fp_occupancy", None))
         if args.coverage:
             # full per-expression dump (MC.out:44-1092): re-walk the space
             # with the instrumented evaluator (host-side; slow for large
@@ -265,6 +213,162 @@ def _run_check(args) -> int:
     if violated:
         return 12
     return 13 if liveness_violated else 0  # TLC liveness exit convention
+
+
+def _dispatch_check(args, spec, log):
+    """Run the KubeAPI-path engine picked by the flags.  Returns
+    (CheckResult, SupervisedResult-or-None).
+
+    Dispatch priority: DiskFPSet routes to the host tier even when
+    -sharded is given (sharding then means fingerprint-space partitions).
+    The resil supervisor wraps the device engines whenever -auto-grow
+    (default) or -checkpoint is in play; -no-auto-grow without
+    -checkpoint keeps the raw fused single-dispatch path."""
+    import jax
+
+    if args.sharded and args.fpset != "DiskFPSet":
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from .engine.sharded import check_sharded
+
+        mesh = Mesh(np.array(jax.devices()[: args.sharded]), ("fp",))
+        if args.checkpoint or args.autogrow:
+            from .resil import check_sharded_supervised
+
+            sup = check_sharded_supervised(
+                spec.model,
+                mesh,
+                chunk=args.chunk,
+                queue_capacity=args.qcap,
+                fp_capacity=args.fpcap,
+                route_factor=args.routefactor,
+                opts=_sup_opts(args, log),
+            )
+            return sup.result, sup
+        return check_sharded(
+            spec.model,
+            mesh,
+            chunk=args.chunk,
+            queue_capacity=args.qcap,
+            fp_capacity=args.fpcap,
+            route_factor=args.routefactor,
+        ), None
+    if args.fpset == "DiskFPSet":
+        # the OffHeapDiskFPSet/DiskStateQueue analog: authoritative dedup +
+        # frontier in the native (C++, disk-bounded) host tier.  Composes
+        # with -checkpoint (the disk tier's files ARE the snapshot, as in
+        # TLC) and with -sharded N (N fingerprint-space partitions - the
+        # distributed-fingerprint-server analog, launch:4)
+        from .engine.hybrid import check_hybrid
+
+        nparts = max(args.sharded, 1)
+        if nparts & (nparts - 1):
+            raise FileNotFoundError(
+                "-sharded with -fpset DiskFPSet needs a power-of-two "
+                f"partition count, got {nparts}"
+            )
+        return check_hybrid(
+            spec.model,
+            chunk=args.chunk,
+            fp_index=spec.fp_index,
+            fp_partitions=nparts,
+            ckpt_path=args.checkpoint or None,
+            ckpt_every=args.checkpointevery,
+            resume=args.recover,
+        ), None
+    if args.checkpoint or args.autogrow:
+        from .resil import check_supervised
+
+        sup = check_supervised(
+            spec.model,
+            chunk=args.chunk,
+            queue_capacity=args.qcap,
+            fp_capacity=args.fpcap,
+            fp_index=spec.fp_index,
+            opts=_sup_opts(args, log),
+        )
+        return sup.result, sup
+    from .engine.bfs import check
+
+    return check(
+        spec.model,
+        chunk=args.chunk,
+        queue_capacity=args.qcap,
+        fp_capacity=args.fpcap,
+        fp_index=spec.fp_index,
+    ), None
+
+
+def _sup_opts(args, log):
+    """SupervisorOptions from the CLI flags, with supervisor events
+    rendered as TLC-style banners."""
+    from .resil import FaultPlan, SupervisorOptions
+
+    def on_event(kind, info):
+        if kind == "checkpoint":
+            log.checkpoint_saved(info["path"])
+        elif kind == "recovery":
+            log.recovery(info["path"], info["distinct"])
+        elif kind == "regrow":
+            log.regrow(info["resource"], info["old"], info["new"],
+                       info["violation"])
+        elif kind == "progress":
+            log.progress(info["depth"], info["generated"],
+                         info["distinct"], info["queue"])
+        elif kind == "retry":
+            log.msg(
+                1000,
+                f"Transient error (attempt {info['attempt']}): "
+                f"{info['error']}; retrying in {info['delay_s']}s from "
+                "the last good state.",
+                severity=1,
+            )
+        elif kind == "ckpt_write_failed":
+            log.msg(
+                1000,
+                f"Checkpoint write failed: {info['error']} (run "
+                "continues; the next segment boundary retries).",
+                severity=1,
+            )
+        elif kind == "ckpt_fallback":
+            log.msg(
+                1000,
+                f"Checkpoint {info['path']} failed verification "
+                f"({info['error']}); falling back to the previous "
+                "generation.",
+                severity=1,
+            )
+        elif kind == "interrupted":
+            log.interrupted(info["signum"], info["path"],
+                            _resume_command(args))
+
+    return SupervisorOptions(
+        auto_grow=args.autogrow,
+        max_regrow=args.maxregrow,
+        retries=args.retry,
+        ckpt_path=args.checkpoint or None,
+        ckpt_every=args.checkpointevery,
+        resume=args.recover,
+        faults=FaultPlan.parse(args.faults) if args.faults else None,
+        on_event=on_event,
+    )
+
+
+def _resume_command(args) -> str:
+    """The command an interrupted run prints (geometry travels inside the
+    checkpoint meta, so only the run-shaping flags need repeating)."""
+    parts = ["python -m jaxtlc.cli check", args.config]
+    if args.checkpoint:
+        parts += ["-checkpoint", args.checkpoint, "-recover"]
+    if args.chunk != 1024:
+        parts += ["-chunk", str(args.chunk)]
+    if args.sharded:
+        parts += ["-sharded", str(args.sharded)]
+    if not args.checkpoint:
+        return ("re-run from scratch (no -checkpoint was set): "
+                + " ".join(parts))
+    return " ".join(parts)
 
 
 def _render_sources(cfg_path: str, spec_name: str) -> dict:
@@ -710,7 +814,32 @@ def main(argv=None) -> int:
     c.add_argument("-checkpointevery", type=int, default=256, metavar="N",
                    help="chunks between checkpoints")
     c.add_argument("-recover", action="store_true",
-                   help="resume from -checkpoint PATH (TLC -recover analog)")
+                   help="resume from -checkpoint PATH (TLC -recover "
+                        "analog); the newest intact generation is loaded, "
+                        "with fallback past a torn newest file")
+    c.add_argument("-auto-grow", dest="autogrow", action="store_true",
+                   default=True,
+                   help="(default) on fpset/queue/route saturation, double "
+                        "the saturated resource, migrate the carry, and "
+                        "resume instead of aborting")
+    c.add_argument("-no-auto-grow", dest="autogrow", action="store_false",
+                   help="disable auto-regrow: capacity exhaustion aborts "
+                        "with the sizing hint (the pre-supervisor "
+                        "behavior); without -checkpoint this also "
+                        "restores the raw fused single-dispatch engine")
+    c.add_argument("-max-regrow", dest="maxregrow", type=int, default=8,
+                   metavar="N",
+                   help="max auto-regrow events per run (each doubles one "
+                        "resource, so 8 allows 256x growth)")
+    c.add_argument("-retry", type=int, default=2, metavar="N",
+                   help="retries per segment around transient device/XLA "
+                        "errors (exponential backoff with jitter, "
+                        "restoring the last good state)")
+    c.add_argument("-faults", default="", metavar="PLAN",
+                   help="self-test: deterministic fault plan for the "
+                        "supervisor (e.g. 'transient@1,sigterm@3,"
+                        "write_fail@2,truncate@1'; tools/chaos.py drives "
+                        "this end-to-end)")
     c.add_argument("-coverage", action="store_true",
                    help="emit the full per-expression coverage dump "
                         "(TLC coverage mode; re-walks the space host-side)")

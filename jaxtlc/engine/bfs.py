@@ -119,6 +119,11 @@ class CheckResult(NamedTuple):
     # TLC's "based on the actual fingerprints" collision estimate
     # (MC.out:42); None when the engine variant doesn't compute it
     actual_fp_collision: float = None
+    # final fingerprint-table load: distinct / fp_capacity (summed over
+    # shards for the mesh engine); None when the driver didn't compute it.
+    # Reported on the 2193 stats line so users can size fp_capacity (and
+    # see how close a run came to the fp_highwater regrow trigger)
+    fp_occupancy: float = None
 
 
 def carry_done(carry: EngineCarry) -> bool:
@@ -128,6 +133,9 @@ def carry_done(carry: EngineCarry) -> bool:
     ) or int(carry.viol) != OK
 
 
+DEFAULT_FP_HIGHWATER = 0.85
+
+
 def make_engine(
     cfg: ModelConfig,
     chunk: int = 1024,
@@ -135,6 +143,7 @@ def make_engine(
     fp_capacity: int = 1 << 20,
     fp_index: int = DEFAULT_FP_INDEX,
     seed: int = DEFAULT_SEED,
+    fp_highwater: float = DEFAULT_FP_HIGHWATER,
 ):
     """Build (init_fn, run_fn, step_fn) for one configuration.
 
@@ -145,7 +154,13 @@ def make_engine(
 
     queue_capacity bounds the width of a single BFS level (the frontier),
     not the total state count: levels ping-pong between two buffers.
+
+    fp_highwater is the fingerprint-table load fraction at which the run
+    halts with VIOL_FPSET_FULL instead of degrading into long straggler
+    walks (open addressing past ~0.85 load is where probe cost blows up);
+    the supervisor's auto-regrow doubles fp_capacity at this trigger.
     """
+    assert 0.0 < fp_highwater <= 1.0, "fp_highwater must be in (0, 1]"
     cdc = get_codec(cfg)
     F = cdc.n_fields
     W = (cdc.nbits + 31) // 32
@@ -243,7 +258,7 @@ def make_engine(
         lo, hi = fp64_words_mxu(packed, nbits, fp_index, seed)
 
         fp_full = (c.distinct.astype(jnp.int32) + ncand) > int(
-            fp_capacity * 0.85
+            fp_capacity * fp_highwater
         )
         insert_mask = fvalid & ~fp_full
         fps, is_new_c, c_idx, nreps = fpset_insert_sorted(
@@ -452,6 +467,7 @@ def check(
     fp_capacity: int = 1 << 20,
     fp_index: int = DEFAULT_FP_INDEX,
     seed: int = DEFAULT_SEED,
+    fp_highwater: float = DEFAULT_FP_HIGHWATER,
 ) -> CheckResult:
     """Run an exhaustive check; the single-device engine entry point.
 
@@ -460,7 +476,8 @@ def check(
     (compilation is a one-time cost, amortized in TLC by the JVM the same
     way)."""
     init_fn, run_fn, _ = make_engine(
-        cfg, chunk, queue_capacity, fp_capacity, fp_index, seed
+        cfg, chunk, queue_capacity, fp_capacity, fp_index, seed,
+        fp_highwater=fp_highwater,
     )
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()
@@ -470,7 +487,9 @@ def check(
     from .fpset import fpset_actual_collision
 
     afc = float(fpset_actual_collision(carry.fps))
-    return result_from_carry(carry, wall)._replace(actual_fp_collision=afc)
+    return result_from_carry(carry, wall, fp_capacity=fp_capacity)._replace(
+        actual_fp_collision=afc
+    )
 
 
 class EnumCarry(NamedTuple):
@@ -495,6 +514,7 @@ def make_enumerator(
     fp_capacity: int = 1 << 20,
     fp_index: int = DEFAULT_FP_INDEX,
     seed: int = DEFAULT_SEED,
+    fp_highwater: float = DEFAULT_FP_HIGHWATER,
 ):
     """Build (init_fn, run_fn) for the fused distinct-state enumerator.
 
@@ -560,7 +580,7 @@ def make_enumerator(
         packed = cdc.pack(flat)
         lo, hi = fp64_words_mxu(packed, nbits, fp_index, seed)
 
-        fp_full = (c.tail + ncand) > int(fp_capacity * 0.85)
+        fp_full = (c.tail + ncand) > int(fp_capacity * fp_highwater)
         fps, is_new_c, c_idx, _ = fpset_insert_sorted(
             c.fps, lo, hi, fvalid & ~fp_full, probe_width=R, claim_width=R
         )
@@ -639,13 +659,17 @@ def outdegree_from_hist(hist: np.ndarray):
 
 
 def result_from_carry(
-    carry: EngineCarry, wall_s: float, iterations: int = -1
+    carry: EngineCarry, wall_s: float, iterations: int = -1,
+    fp_capacity: int = 0,
 ) -> CheckResult:
     """Pull a finished (or interrupted) carry to host as a CheckResult."""
     act_gen = np.asarray(carry.act_gen)[: len(LABELS)]
     act_dist = np.asarray(carry.act_dist)[: len(LABELS)]
     hist = np.asarray(carry.outdeg_hist)[:-1].astype(np.int64)  # drop dump
     outdegree = outdegree_from_hist(hist)
+    occupancy = (
+        int(carry.distinct) / fp_capacity if fp_capacity else None
+    )
     return CheckResult(
         generated=int(carry.generated),
         distinct=int(carry.distinct),
@@ -664,4 +688,5 @@ def result_from_carry(
         wall_s=wall_s,
         iterations=iterations,
         outdegree=outdegree,
+        fp_occupancy=occupancy,
     )

@@ -19,9 +19,12 @@ trade-off.
 from __future__ import annotations
 
 import dataclasses
+import glob
 import json
 import os
+import re
 import time
+import zlib
 from typing import NamedTuple, Optional
 
 import jax
@@ -30,6 +33,7 @@ from jax import lax
 
 from ..config import ModelConfig
 from .bfs import (
+    DEFAULT_FP_HIGHWATER,
     CheckResult,
     EngineCarry,
     carry_done,
@@ -41,7 +45,18 @@ from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
 # v2: fingerprint-table layout changed from triangular avalanche-hash
 # probing to bucketized top-bits-of-hi (fpset v4); a v1 table's rows sit at
 # slots the v4 walk never visits, so version skew must be rejected loudly.
-FORMAT_VERSION = 2
+# v3: per-array CRC32 manifest in __meta__ (crash-consistency: a torn or
+# bit-rotted file is detected at load instead of recovering into garbage)
+# + fp_highwater recorded in meta.
+FORMAT_VERSION = 3
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint file failed integrity verification (truncated npz,
+    CRC mismatch, or missing manifest).  Distinct from plain ValueError
+    geometry mismatches so the generation-fallback loader can tell
+    'wrong file' (fatal) from 'torn file' (fall back to the previous
+    generation)."""
 
 
 def _meta(cfg: ModelConfig, meta_config: dict = None,
@@ -61,22 +76,84 @@ def _meta(cfg: ModelConfig, meta_config: dict = None,
     )
 
 
-def save_checkpoint(path: str, carry: EngineCarry, meta: dict) -> None:
-    """Atomic snapshot: leaves as npz + json meta, tmp-file + rename."""
+def fsync_replace(tmp: str, path: str, f=None) -> None:
+    """Durable atomic publish: fsync the tmp file (before the rename, so a
+    crash cannot publish a name whose bytes never hit the platter - rename
+    alone only orders the metadata), rename, then fsync the directory so
+    the rename itself is durable."""
+    if f is not None:
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dirfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                    os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+
+
+def save_checkpoint(path: str, carry, meta: dict) -> None:
+    """Crash-consistent snapshot: leaves as npz + json meta with a
+    per-array CRC32 manifest, fsync'd tmp-file + rename (torn writes are
+    either invisible - the old file survives - or detected at load)."""
     leaves = jax.tree_util.tree_leaves(carry)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    manifest = {
+        k: zlib.crc32(np.ascontiguousarray(a).tobytes())
+        for k, a in arrays.items()
+    }
+    meta = {**meta, "manifest": manifest}
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez_compressed(f, __meta__=json.dumps(meta), **arrays)
-    os.replace(tmp, path)
+        fsync_replace(tmp, path, f=f)
+
+
+def read_checkpoint_meta(path: str) -> dict:
+    """Read only the meta dict of a checkpoint (no leaf verification).
+
+    The supervisor uses this to rebuild an engine with the GEOMETRY THE
+    CHECKPOINT RECORDS (auto-regrown capacities included) before loading
+    the leaves, so a resume command never needs to repeat the grown
+    sizes.  Raises CheckpointCorruptError on unreadable files."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            return json.loads(str(z["__meta__"]))
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:  # truncated zip, missing key, bad json ...
+        raise CheckpointCorruptError(f"unreadable checkpoint {path!r}: {e}")
 
 
 def load_checkpoint(path: str, template: EngineCarry):
-    """Load a snapshot into the structure of `template` (an EngineCarry from
-    the same engine geometry).  Returns (meta, carry)."""
-    with np.load(path, allow_pickle=False) as z:
-        meta = json.loads(str(z["__meta__"]))
-        leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
+    """Load + verify a snapshot into the structure of `template` (an
+    EngineCarry from the same engine geometry).  Returns (meta, carry).
+    Raises CheckpointCorruptError when the file is torn or its arrays
+    fail the CRC32 manifest; ValueError on geometry/version mismatch."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+            leaves = [
+                z[f"leaf_{i}"]
+                for i in range(sum(k.startswith("leaf_") for k in z.files))
+            ]
+    except Exception as e:  # BadZipFile / zlib.error / KeyError / json ...
+        # the file-parsing boundary: ANY read failure here means a torn or
+        # rotten file, which the generation fallback is built to survive
+        raise CheckpointCorruptError(f"unreadable checkpoint {path!r}: {e}")
+    manifest = meta.get("manifest")
+    if manifest is not None:
+        for i, a in enumerate(leaves):
+            want = manifest.get(f"leaf_{i}")
+            got = zlib.crc32(np.ascontiguousarray(a).tobytes())
+            if want is None or got != want:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path!r} leaf_{i} CRC mismatch "
+                    f"({got} != {want}) - torn write or bit rot"
+                )
     t_leaves, treedef = jax.tree_util.tree_flatten(template)
     if len(leaves) != len(t_leaves):
         raise ValueError(
@@ -97,6 +174,68 @@ def load_checkpoint(path: str, template: EngineCarry):
     return meta, jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+_GEN_RE = re.compile(r"\.g(\d{6})\.npz$")
+
+
+def generation_path(base: str, gen: int) -> str:
+    """File name of generation `gen` of the checkpoint family `base`."""
+    return f"{base}.g{gen:06d}.npz"
+
+
+def list_generations(base: str):
+    """[(gen, path)] of all on-disk generations of `base`, ascending."""
+    out = []
+    for p in glob.glob(f"{glob.escape(base)}.g??????.npz"):
+        m = _GEN_RE.search(p)
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out)
+
+
+def save_generation(base: str, carry, meta: dict, keep: int = 2) -> str:
+    """Write the next generation of the checkpoint family `base`, then
+    prune to the newest `keep` generations.  Because the previous
+    generation is deleted only AFTER the new one is durably published, a
+    torn newest file always leaves a verified-good predecessor to fall
+    back to (load_latest_generation walks newest-first)."""
+    gens = list_generations(base)
+    gen = (gens[-1][0] + 1) if gens else 1
+    path = generation_path(base, gen)
+    meta = {**meta, "generation": gen}
+    save_checkpoint(path, carry, meta)
+    for old_gen, old_path in gens[: max(0, len(gens) - (keep - 1))]:
+        try:
+            os.remove(old_path)
+        except OSError:
+            pass  # pruning is best-effort; never fail a save over it
+    return path
+
+
+def load_latest_generation(base: str, template):
+    """Load the newest generation that passes integrity verification.
+
+    Walks generations newest-first; a corrupt (torn/CRC-failing) file is
+    skipped with a fallback to its predecessor - the crash-window case
+    the generation scheme exists for.  Geometry/config mismatches
+    (plain ValueError) still raise: a WRONG checkpoint must never be
+    silently skipped.  Returns (path, meta, carry); raises
+    FileNotFoundError when no loadable generation exists."""
+    gens = list_generations(base)
+    last_err = None
+    for gen, path in reversed(gens):
+        try:
+            meta, carry = load_checkpoint(path, template)
+            return path, meta, carry
+        except CheckpointCorruptError as e:
+            last_err = e
+    if last_err is not None:
+        raise FileNotFoundError(
+            f"no intact checkpoint generation under {base!r} "
+            f"(newest failure: {last_err})"
+        )
+    raise FileNotFoundError(f"no checkpoint generations under {base!r}")
+
+
 def check_with_checkpoints(
     cfg: ModelConfig,
     chunk: int = 1024,
@@ -109,6 +248,7 @@ def check_with_checkpoints(
     resume: bool = False,
     max_segments: Optional[int] = None,
     on_progress=None,
+    fp_highwater: float = DEFAULT_FP_HIGHWATER,
 ) -> CheckResult:
     """Exhaustive check with periodic checkpoints every `ckpt_every` chunks.
 
@@ -123,7 +263,8 @@ def check_with_checkpoints(
     does).
     """
     init_fn, _, step_fn = make_engine(
-        cfg, chunk, queue_capacity, fp_capacity, fp_index, seed
+        cfg, chunk, queue_capacity, fp_capacity, fp_index, seed,
+        fp_highwater=fp_highwater,
     )
     meta = _meta(
         cfg,
@@ -132,6 +273,7 @@ def check_with_checkpoints(
         fp_capacity=fp_capacity,
         fp_index=fp_index,
         seed=seed,
+        fp_highwater=fp_highwater,
     )
 
     @jax.jit
@@ -150,7 +292,7 @@ def check_with_checkpoints(
         # the adaptive-step bodies (only the checkpoint CADENCE may change
         # across a resume)
         for key in ("format", "config", "chunk", "queue_capacity",
-                    "fp_capacity", "fp_index", "seed"):
+                    "fp_capacity", "fp_index", "seed", "fp_highwater"):
             if saved_meta.get(key) != meta[key]:
                 raise ValueError(
                     f"checkpoint {key} mismatch: "

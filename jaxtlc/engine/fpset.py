@@ -61,6 +61,25 @@ from jax import lax
 BUCKET = 8  # slots per bucket; 64-byte bucket rows gather in one access
 
 
+class CapacityError(RuntimeError):
+    """A fingerprint table (or another bounded resource) ran out of room.
+
+    Carries the saturated resource's occupancy/capacity so callers - the
+    run supervisor above all (jaxtlc.resil.supervisor) - can react
+    programmatically (regrow, checkpoint, report) instead of string-
+    matching an exception message."""
+
+    def __init__(self, occupancy: int, capacity: int,
+                 resource: str = "fpset"):
+        self.occupancy = int(occupancy)
+        self.capacity = int(capacity)
+        self.resource = resource
+        super().__init__(
+            f"{resource} full: {self.occupancy}/{self.capacity} slots "
+            f"occupied (raise the {resource} capacity or enable auto-grow)"
+        )
+
+
 class FPSet(NamedTuple):
     # [cap / BUCKET, 2 * BUCKET] uint32: bucket rows, slots interleaved
     # lo0,hi0,...  A flat [cap, 2] view in slot order is table.reshape(-1, 2).
@@ -183,6 +202,33 @@ def mix_host(lo: int, hi: int) -> Tuple[int, int]:
     return lo, hi
 
 
+def _fmix32_np(h: np.ndarray) -> np.ndarray:
+    h = h.astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    h ^= h >> np.uint32(13)
+    h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    return h
+
+
+def unmix_host(lo: np.ndarray, hi: np.ndarray):
+    """Vectorized host inverse of _mix over uint32 arrays: recovers raw
+    fingerprints from stored table words.  The regrow migration
+    (jaxtlc.resil.regrow) unmixes a saturated table's entries and feeds
+    them back through fpset_insert_sorted into the larger geometry, so
+    the new table's stored words are reproduced exactly."""
+    lo = np.asarray(lo, np.uint32).copy()
+    hi = np.asarray(hi, np.uint32).copy()
+    with np.errstate(over="ignore"):
+        for c in (0x27220A95, 0x517CC1B7, 0x9E3779B9):
+            lo, hi = (
+                hi ^ _fmix32_np((lo + np.uint32(c)).astype(np.uint32)),
+                lo,
+            )
+    return lo, hi
+
+
 def _bucket_of(hi, nbuckets: int):
     """Home bucket = top log2(nbuckets) bits of hi (monotonic in (hi, lo)
     sort order - the property the conflict-free rank claims rely on)."""
@@ -218,7 +264,7 @@ def host_insert(table: np.ndarray, lo: int, hi: int) -> bool:
             table[slot, 0] = lo
             table[slot, 1] = hi
             return True
-    raise RuntimeError("fingerprint table full")
+    raise CapacityError(cap, cap)
 
 
 def _probe_block(table, lo, hi, active, claim_width: int):

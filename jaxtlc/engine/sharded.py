@@ -186,6 +186,7 @@ def make_sharded_engine(
     route_factor: float = 2.0,
     segment: int = 0,
     backend: SpecBackend = None,
+    fp_highwater: float = None,
 ):
     """Build (init_fn, run_fn) over `mesh` (single axis named "fp").
 
@@ -207,6 +208,11 @@ def make_sharded_engine(
     (axis,) = mesh.axis_names
     D = mesh.devices.size
     assert D & (D - 1) == 0, "device count must be a power of two"
+    if fp_highwater is None:
+        from .bfs import DEFAULT_FP_HIGHWATER
+
+        fp_highwater = DEFAULT_FP_HIGHWATER
+    assert 0.0 < fp_highwater <= 1.0, "fp_highwater must be in (0, 1]"
     if backend is None:
         backend = kubeapi_backend(cfg)
     cdc = backend.cdc
@@ -352,7 +358,7 @@ def make_sharded_engine(
         # ---- dedup + insert at owner ----
         my_distinct = c.distinct[0]
         fp_full = (my_distinct.astype(jnp.int32) + D * B) > int(
-            fp_capacity * 0.85
+            fp_capacity * fp_highwater
         )
         ins_mask = r_valid & ~fp_full
         fset, is_new = fpset_insert(FPSet(table), r_lo, r_hi, ins_mask)
@@ -491,8 +497,12 @@ def make_sharded_engine(
 def result_from_shard_carry(
     out: ShardCarry, wall: float, iterations: int = -1,
     labels: tuple = LABELS, viol_names: dict = None,
+    fp_capacity_total: int = 0,
 ) -> CheckResult:
-    """Globally-reduced statistics from a (finished or paused) carry."""
+    """Globally-reduced statistics from a (finished or paused) carry.
+
+    fp_capacity_total (= per-device fp_capacity * device count) enables
+    the fp_occupancy fraction on the result."""
     act_gen = np.asarray(out.act_gen).sum(axis=0)[: len(labels)]
     act_dist = np.asarray(out.act_dist).sum(axis=0)[: len(labels)]
     hist = np.asarray(out.outdeg_hist).sum(axis=0)[:-1].astype(np.int64)
@@ -522,6 +532,10 @@ def result_from_shard_carry(
         wall_s=wall,
         iterations=iterations,
         outdegree=outdegree_from_hist(hist),
+        fp_occupancy=(
+            int(np.asarray(out.distinct).sum()) / fp_capacity_total
+            if fp_capacity_total else None
+        ),
     )
 
 
@@ -620,7 +634,8 @@ def check_sharded(
     out = jax.block_until_ready(compiled(carry))
     wall = time.time() - t0
     return result_from_shard_carry(
-        out, wall, labels=backend.labels, viol_names=backend.viol_names
+        out, wall, labels=backend.labels, viol_names=backend.viol_names,
+        fp_capacity_total=fp_capacity * mesh.devices.size,
     )
 
 
@@ -687,4 +702,5 @@ def check_sharded_with_checkpoints(
     return result_from_shard_carry(
         carry, time.time() - t0, iterations=segments,
         labels=backend.labels, viol_names=backend.viol_names,
+        fp_capacity_total=fp_capacity * mesh.devices.size,
     )

@@ -183,9 +183,12 @@ class TLCLog:
         return re.sub(r"E([+-])0+(\d)", r"E\1\2", f"{v:.1E}")
 
     def success(self, generated: int, distinct: int,
-                actual: float = None) -> None:
+                actual: float = None, occupancy: float = None) -> None:
         """The full 2193 success text (MC.out:38-42): both collision
-        estimates when the engine computed the actual-fingerprint one."""
+        estimates when the engine computed the actual-fingerprint one,
+        plus the final fingerprint-table load fraction (the auto-grow
+        trigger is a fraction of capacity, so this line is how users see
+        how close a run came to regrowing)."""
         p = collision_probability(generated, distinct)
         body = (
             "Model checking completed. No error has been found.\n"
@@ -198,6 +201,11 @@ class TLCLog:
             body += (
                 f"\n  based on the actual fingerprints:  "
                 f"val = {self._efmt(actual)}"
+            )
+        if occupancy is not None:
+            body += (
+                f"\n  fingerprint table occupancy: {occupancy:.1%} of "
+                "capacity"
             )
         self.msg(2193, body)
 
@@ -284,6 +292,45 @@ class TLCLog:
         self.msg(
             2186,
             f"Finished in {ms}ms at ({time.strftime('%Y-%m-%d %H:%M:%S')})",
+        )
+
+    # -- resilience (supervisor events) -------------------------------------
+
+    def checkpoint_saved(self, path: str) -> None:
+        """TLC's checkpoint banner (code 2195, "Checkpointing of run ...
+        completed"), naming the generation file the supervisor wrote."""
+        self.msg(2195, f"Checkpointing of run completed: {path}")
+
+    def recovery(self, path: str, distinct: int) -> None:
+        """TLC's -recover banner (code 2196): which snapshot the run
+        resumed from and how much state it restored."""
+        self.msg(
+            2196,
+            f"Starting recovery from checkpoint {path}: {distinct:,} "
+            "distinct states restored.",
+        )
+
+    def regrow(self, resource: str, old, new, reason: str) -> None:
+        """Auto-regrow event (code 2198, jaxtlc extension): the engine was
+        rebuilt with `resource` doubled and the carry migrated - TLC has
+        no analog (its disk structures grow implicitly; device tables
+        cannot)."""
+        self.msg(
+            2198,
+            f"Capacity exhausted ({reason}); regrowing {resource} "
+            f"{old} -> {new} and resuming from the last good carry.",
+        )
+
+    def interrupted(self, signum, path, resume_cmd: str) -> None:
+        """Preemption drain (severity 1): the run checkpointed and is
+        resumable with the printed command."""
+        where = (f"final checkpoint written to {path}" if path
+                 else "no checkpoint path configured - progress lost")
+        self.msg(
+            2186,
+            f"Run interrupted by signal {signum}; {where}.\n"
+            f"Resume with: {resume_cmd}",
+            severity=1,
         )
 
     # -- violations ---------------------------------------------------------

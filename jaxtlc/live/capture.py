@@ -27,6 +27,7 @@ successor whose fingerprint is NOT in the enumerated set halts loudly
 from __future__ import annotations
 
 import os
+import zlib
 from typing import List, NamedTuple, Optional
 
 import jax
@@ -75,11 +76,19 @@ class _EdgeSpill:
             self._spill()
 
     def _spill(self) -> None:
+        from ..engine.checkpoint import fsync_replace
+
         part = f"{self.spill_path}.edges{len(self.parts):05d}.npz"
         tmp = part + ".tmp"
+        edges = np.concatenate(self.blocks)
+        crc = np.uint32(zlib.crc32(np.ascontiguousarray(edges).tobytes()))
         with open(tmp, "wb") as f:
-            np.savez_compressed(f, edges=np.concatenate(self.blocks))
-        os.replace(tmp, part)
+            np.savez_compressed(f, edges=edges, crc=crc)
+            # fsync BEFORE the rename: os.replace alone orders only the
+            # metadata, so a crash could publish a part file whose bytes
+            # never hit the platter - recovered captures would then read
+            # a torn edge relation
+            fsync_replace(tmp, part, f=f)
         self.parts.append(part)
         self.blocks = []
         self.in_ram = 0
@@ -88,7 +97,15 @@ class _EdgeSpill:
         loaded = []
         for part in self.parts:
             with np.load(part) as z:
-                loaded.append(z["edges"])
+                edges = z["edges"]
+                if "crc" in z.files and zlib.crc32(
+                    np.ascontiguousarray(edges).tobytes()
+                ) != int(z["crc"]):
+                    raise IOError(
+                        f"edge-spill part {part!r} failed CRC verification "
+                        "- torn write or bit rot; re-run the capture"
+                    )
+                loaded.append(edges)
             os.remove(part)
         if self.blocks:
             loaded.append(np.concatenate(self.blocks))

@@ -1,0 +1,17 @@
+"""Self-healing runs: supervisor (auto-regrow, preemption-safe exits,
+retry with backoff), carry migration across engine geometries, and the
+deterministic fault-injection harness that proves every recovery path."""
+
+from .faults import FaultInjector, FaultPlan, TransientFault  # noqa: F401
+from .regrow import GROWABLE, grown  # noqa: F401
+from .supervisor import (  # noqa: F401
+    EXIT_INTERRUPTED,
+    ShardedAdapter,
+    SingleDeviceAdapter,
+    SlotOverflowError,
+    SupervisedResult,
+    SupervisorOptions,
+    check_sharded_supervised,
+    check_supervised,
+    supervise,
+)

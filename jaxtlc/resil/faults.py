@@ -1,0 +1,116 @@
+"""Deterministic fault injection for the run supervisor.
+
+Every recovery path the supervisor claims (auto-regrow, retry-with-
+backoff, SIGTERM drain, generation fallback after a torn checkpoint) is
+proven by an INJECTED fault whose recovered run must match the clean
+run's final statistics exactly (tests/test_resil.py, tools/chaos.py).
+A FaultPlan is a fixed schedule - "fail the 2nd disk write", "raise a
+transient error when segment 3 starts", "deliver SIGTERM at segment 2",
+"truncate the checkpoint written at segment 1" - threaded through the
+supervisor's hooks, so a chaos run is reproducible bit-for-bit.
+
+The plan DSL (tools/chaos.py `--plan`):
+
+    write_fail@N    raise OSError on the Nth checkpoint write (1-based)
+    truncate@N      after the Nth checkpoint write succeeds, truncate the
+                    published file mid-byte (simulates the torn write the
+                    fsync+generation scheme defends against)
+    transient@K     raise TransientFault when segment K starts (0-based;
+                    the supervisor's retry/backoff path must absorb it)
+    sigterm@K       deliver a real SIGTERM to this process when segment K
+                    starts (the preemption drain path)
+
+Entries are comma-separated: "transient@1,sigterm@3".  Each entry fires
+at most once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from typing import Callable, FrozenSet, Optional
+
+
+class TransientFault(RuntimeError):
+    """An injected stand-in for a transient device/XLA error (the class of
+    failure the supervisor's retry-with-backoff absorbs)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A deterministic fault schedule.  All members are sets of 1-based
+    write ordinals / 0-based segment ordinals; empty = no fault."""
+
+    write_fail: FrozenSet[int] = frozenset()
+    truncate: FrozenSet[int] = frozenset()
+    transient: FrozenSet[int] = frozenset()
+    sigterm: FrozenSet[int] = frozenset()
+
+    @staticmethod
+    def parse(spec: str) -> "FaultPlan":
+        """Parse the chaos DSL ("write_fail@2,transient@1,sigterm@3")."""
+        kinds = {"write_fail": set(), "truncate": set(),
+                 "transient": set(), "sigterm": set()}
+        for entry in filter(None, (e.strip() for e in spec.split(","))):
+            try:
+                kind, at = entry.split("@")
+                kinds[kind].add(int(at))
+            except (ValueError, KeyError):
+                raise ValueError(
+                    f"bad fault entry {entry!r} (want kind@N with kind in "
+                    f"{sorted(kinds)})"
+                )
+        return FaultPlan(**{k: frozenset(v) for k, v in kinds.items()})
+
+
+class FaultInjector:
+    """Runtime state of one plan: counts writes/segments, fires each
+    scheduled fault exactly once.  A None plan injects nothing (the
+    production configuration - the hooks cost a comparison each)."""
+
+    def __init__(self, plan: Optional[FaultPlan] = None,
+                 kill: Callable[[], None] = None):
+        self.plan = plan or FaultPlan()
+        self.writes = 0
+        self.fired = set()
+        # test seam: default delivers a real SIGTERM to this process
+        self._kill = kill or (
+            lambda: os.kill(os.getpid(), signal.SIGTERM)
+        )
+
+    def _once(self, key) -> bool:
+        if key in self.fired:
+            return False
+        self.fired.add(key)
+        return True
+
+    def segment_start(self, k: int) -> None:
+        """Hook: the supervisor is about to run segment k (0-based)."""
+        if k in self.plan.sigterm and self._once(("sigterm", k)):
+            self._kill()
+        if k in self.plan.transient and self._once(("transient", k)):
+            raise TransientFault(f"injected transient fault at segment {k}")
+
+    def before_write(self) -> None:
+        """Hook: a checkpoint write is about to happen (counts 1-based)."""
+        self.writes += 1
+        if self.writes in self.plan.write_fail and self._once(
+            ("write_fail", self.writes)
+        ):
+            raise OSError(f"injected disk-write failure #{self.writes}")
+
+    def after_write(self, path: str) -> None:
+        """Hook: checkpoint write #self.writes published `path`."""
+        if self.writes in self.plan.truncate and self._once(
+            ("truncate", self.writes)
+        ):
+            truncate_file(path)
+
+
+def truncate_file(path: str, frac: float = 0.5) -> None:
+    """Tear a published file: keep the leading `frac` of its bytes.  The
+    generation fallback must then recover from the predecessor."""
+    size = os.path.getsize(path)
+    with open(path, "rb+") as f:
+        f.truncate(max(1, int(size * frac)))

@@ -1,0 +1,221 @@
+"""Carry migration between engine geometries - the auto-regrow core.
+
+A capacity halt (VIOL_FPSET_FULL / VIOL_QUEUE_FULL / VIOL_ROUTE_OVERFLOW)
+reaches the supervisor as a poisoned carry: the saturating step already
+popped a chunk whose successors were discarded, so the post-violation
+carry cannot simply continue.  The supervisor therefore always regrows
+from the LAST GOOD carry (the segment boundary before the halt): the
+functions here rebuild that carry inside the doubled geometry -
+re-inserting every stored fingerprint into the larger bucketized table,
+re-seating the frontier buffers, preserving every counter bit-for-bit -
+and the supervisor replays the segment.  Because a segment is a pure
+function of the carry and dedup verdicts are independent of table
+geometry (fpset sort-compaction orders candidates by fingerprint, not by
+slot), the regrown run's final statistics equal an uninterrupted
+correctly-sized run's exactly (tests/test_resil.py pins this).
+
+What is NOT regrowable: VIOL_SLOT_OVERFLOW means the codec's per-field
+bit widths are too narrow - a recompile of the codec/kernel, not a carry
+migration; the supervisor degrades that to checkpoint + actionable error.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.bfs import (
+    VIOL_FPSET_FULL,
+    VIOL_QUEUE_FULL,
+    VIOL_ROUTE_OVERFLOW,
+    EngineCarry,
+)
+from ..engine.fpset import (
+    BUCKET,
+    FPSet,
+    fpset_insert_sorted,
+    fpset_new,
+    unmix_host,
+)
+from ..engine.sharded import ShardCarry
+
+# violation code -> the engine parameter whose doubling clears it
+# (route_factor is sharded-only: a pure engine-geometry knob, the carry
+# passes through migration unchanged)
+GROWABLE = {
+    VIOL_FPSET_FULL: "fp_capacity",
+    VIOL_QUEUE_FULL: "queue_capacity",
+    VIOL_ROUTE_OVERFLOW: "route_factor",
+}
+
+
+def grown(params: Dict, resource: str) -> Dict:
+    """The parameter dict with `resource` doubled (capacities stay powers
+    of two; route_factor is a float multiplier)."""
+    out = dict(params)
+    out[resource] = (
+        out[resource] * 2.0 if resource == "route_factor"
+        else int(out[resource]) * 2
+    )
+    return out
+
+
+def migrate_table(old_table: np.ndarray, new_capacity: int,
+                  batch: int = 8192) -> FPSet:
+    """Re-insert every stored fingerprint into a fresh table of
+    `new_capacity` slots.
+
+    Stored words are avalanche-MIXED; they are unmixed host-side
+    (fpset.unmix_host) and fed back through the production insert path
+    (fpset_insert_sorted), so the new table is exactly what a from-scratch
+    run with the larger capacity would have built for the same fingerprint
+    set.  Asserts that no entry was lost or duplicated."""
+    old_table = np.asarray(old_table)
+    lo = old_table[:, 0::2].reshape(-1)
+    hi = old_table[:, 1::2].reshape(-1)
+    occ = (lo != 0) | (hi != 0)
+    lo, hi = lo[occ], hi[occ]
+    n = int(lo.shape[0])
+    assert n <= new_capacity, "new capacity below current occupancy"
+    raw_lo, raw_hi = unmix_host(lo, hi)
+    fps = fpset_new(new_capacity)
+    inserted = 0
+    for off in range(0, n, batch):
+        b_lo = raw_lo[off : off + batch]
+        b_hi = raw_hi[off : off + batch]
+        nb = len(b_lo)
+        if nb < batch:
+            b_lo = np.pad(b_lo, (0, batch - nb))
+            b_hi = np.pad(b_hi, (0, batch - nb))
+        mask = np.arange(batch) < nb
+        fps, is_new, _, _ = fpset_insert_sorted(
+            fps, jnp.asarray(b_lo), jnp.asarray(b_hi), jnp.asarray(mask)
+        )
+        inserted += int(np.asarray(is_new).sum())
+    assert inserted == n, (
+        f"fpset migration lost entries: {inserted} != {n}"
+    )
+    return fps
+
+
+def migrate_engine_carry(
+    carry, old_params: Dict, new_params: Dict
+) -> EngineCarry:
+    """Rebuild a single-device EngineCarry inside the new geometry.
+
+    `carry` is a last-good (pre-violation) carry, host- or device-side.
+    Counters, level fencing, and the pop cursor are preserved verbatim;
+    only the containers are re-seated: the fingerprint table is
+    re-bucketized into the larger capacity and the ping-pong level buffers
+    are copied into the wider queue (normalized to parity 0)."""
+    chunk = (int(np.asarray(carry.queue).shape[1])
+             - int(old_params["queue_capacity"])) // 2
+    W = int(np.asarray(carry.queue).shape[2])
+    qcap2 = int(new_params["queue_capacity"])
+    old_queue = np.asarray(carry.queue)
+    par = int(carry.parity)
+    lvl = int(carry.level_n)
+    nxt = int(carry.next_n)
+    assert lvl <= qcap2 and nxt <= qcap2, "regrown queue still too small"
+
+    queue2 = np.zeros((2, qcap2 + 2 * chunk, W), np.uint32)
+    queue2[0, :lvl] = old_queue[par, :lvl]
+    queue2[1, :nxt] = old_queue[1 - par, :nxt]
+
+    fp_cap2 = int(new_params["fp_capacity"])
+    if fp_cap2 != int(old_params["fp_capacity"]):
+        fps2 = migrate_table(np.asarray(carry.fps.table), fp_cap2)
+    else:
+        fps2 = FPSet(jnp.asarray(np.asarray(carry.fps.table)))
+        assert fps2.table.shape[0] * BUCKET == fp_cap2
+
+    return EngineCarry(
+        fps=fps2,
+        queue=jnp.asarray(queue2),
+        parity=jnp.int32(0),
+        qhead=jnp.int32(int(carry.qhead)),
+        level_n=jnp.int32(lvl),
+        next_n=jnp.int32(nxt),
+        level=jnp.int32(int(carry.level)),
+        depth=jnp.int32(int(carry.depth)),
+        generated=jnp.uint32(int(carry.generated)),
+        distinct=jnp.uint32(int(carry.distinct)),
+        act_gen=jnp.asarray(np.asarray(carry.act_gen), jnp.uint32),
+        act_dist=jnp.asarray(np.asarray(carry.act_dist), jnp.uint32),
+        outdeg_hist=jnp.asarray(np.asarray(carry.outdeg_hist), jnp.uint32),
+        viol=jnp.int32(int(carry.viol)),
+        viol_state=jnp.asarray(np.asarray(carry.viol_state), jnp.int32),
+        viol_action=jnp.int32(int(carry.viol_action)),
+    )
+
+
+def migrate_shard_carry(
+    carry, old_params: Dict, new_params: Dict
+) -> ShardCarry:
+    """Rebuild a ShardCarry inside the new geometry (every capacity is
+    PER DEVICE; fingerprint ownership - hi & (D-1) - is capacity-
+    independent, so entries never move between devices).
+
+    The circular per-device frontier is renumbered to qhead=0 when the
+    queue grows (positions are pop-order-preserving: entry i of the
+    in-flight window lands at slot i).  route_factor growth changes only
+    the engine's all_to_all bucket width - the carry passes through."""
+    D = int(np.asarray(carry.qhead).shape[0])
+    qcap = int(old_params["queue_capacity"])
+    qcap2 = int(new_params["queue_capacity"])
+    fp_cap = int(old_params["fp_capacity"])
+    fp_cap2 = int(new_params["fp_capacity"])
+
+    table = np.asarray(carry.table)
+    if fp_cap2 != fp_cap:
+        table2 = np.stack(
+            [np.asarray(migrate_table(table[d], fp_cap2).table)
+             for d in range(D)]
+        )
+    else:
+        table2 = table
+
+    if qcap2 != qcap:
+        queue = np.asarray(carry.queue)
+        F = queue.shape[2]
+        qhead = np.asarray(carry.qhead)
+        qtail = np.asarray(carry.qtail)
+        level_end = np.asarray(carry.level_end)
+        queue2 = np.zeros((D, qcap2 + 1, F), queue.dtype)
+        qhead2 = np.zeros(D, np.int32)
+        qtail2 = np.zeros(D, np.int32)
+        level_end2 = np.zeros(D, np.int32)
+        for d in range(D):
+            cnt = int(qtail[d] - qhead[d])
+            assert cnt <= qcap2, "regrown queue still too small"
+            idxs = (int(qhead[d]) + np.arange(cnt)) % qcap
+            queue2[d, :cnt] = queue[d][idxs]
+            qtail2[d] = cnt
+            level_end2[d] = int(level_end[d]) - int(qhead[d])
+    else:
+        queue2 = np.asarray(carry.queue)
+        qhead2 = np.asarray(carry.qhead)
+        qtail2 = np.asarray(carry.qtail)
+        level_end2 = np.asarray(carry.level_end)
+
+    return ShardCarry(
+        table=jnp.asarray(table2),
+        queue=jnp.asarray(queue2),
+        qhead=jnp.asarray(qhead2, jnp.int32),
+        qtail=jnp.asarray(qtail2, jnp.int32),
+        level_end=jnp.asarray(level_end2, jnp.int32),
+        level=jnp.asarray(np.asarray(carry.level), jnp.int32),
+        depth=jnp.asarray(np.asarray(carry.depth), jnp.int32),
+        generated=jnp.asarray(np.asarray(carry.generated), jnp.uint32),
+        distinct=jnp.asarray(np.asarray(carry.distinct), jnp.uint32),
+        act_gen=jnp.asarray(np.asarray(carry.act_gen), jnp.uint32),
+        act_dist=jnp.asarray(np.asarray(carry.act_dist), jnp.uint32),
+        outdeg_hist=jnp.asarray(np.asarray(carry.outdeg_hist), jnp.uint32),
+        viol=jnp.asarray(np.asarray(carry.viol), jnp.int32),
+        viol_state=jnp.asarray(np.asarray(carry.viol_state), jnp.int32),
+        viol_local=jnp.asarray(np.asarray(carry.viol_local), bool),
+        cont=jnp.asarray(np.asarray(carry.cont), bool),
+    )

@@ -1,0 +1,585 @@
+"""The run supervisor: self-healing exhaustive runs.
+
+TLC's production value rests on surviving long runs (periodic disk
+checkpoints + `-recover`); the TPU-native engines add three failure modes
+TLC does not have - fixed-capacity device containers (fpset/queue/route
+buckets sized at compile time), preemptible accelerator jobs (SIGTERM is
+how TPU pods die), and transient XLA/device errors.  This module wraps
+the segmented drivers (engine.checkpoint / engine.sharded) in a
+supervision loop that converts all three from run-killers into events:
+
+* **Auto-regrow**: a capacity halt (VIOL_FPSET_FULL / VIOL_QUEUE_FULL /
+  VIOL_ROUTE_OVERFLOW) rebuilds the engine with the saturated resource
+  doubled, migrates the last-good carry into the new geometry
+  (resil.regrow) and replays the segment - final statistics provably
+  equal an uninterrupted correctly-sized run's.  Bounded by max_regrow.
+  VIOL_SLOT_OVERFLOW (codec bit-widths too narrow) is NOT regrowable -
+  it needs a recompile - and degrades to checkpoint + actionable error.
+* **Preemption safety**: SIGTERM/SIGINT finish the current segment,
+  write a final checkpoint generation, and return `interrupted=True`
+  (the CLI exits with EXIT_INTERRUPTED and prints the resume command).
+* **Retry with backoff**: transient errors around segment execution are
+  retried from the last good carry with exponential backoff + jitter
+  (deterministic, seeded) up to `retries` attempts.
+* **Crash-consistent storage**: checkpoints are CRC-manifested,
+  fsync'd, generation-numbered files; resume loads the newest generation
+  that passes verification, falling back past a torn newest file, and
+  rebuilds the engine with the geometry THE CHECKPOINT RECORDS - so a
+  resume command never needs to repeat auto-grown capacities.
+
+Every recovery path is proven by fault injection (resil.faults,
+tools/chaos.py, tests/test_resil.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal
+import time
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import numpy as np
+from jax import lax
+
+from ..engine import checkpoint as ckpt
+from ..engine.bfs import (
+    DEFAULT_FP_HIGHWATER,
+    OK,
+    VIOL_SLOT_OVERFLOW,
+    VIOLATION_NAMES,
+    CheckResult,
+    carry_done,
+    make_engine,
+    result_from_carry,
+)
+from ..engine.fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
+from .faults import FaultInjector, FaultPlan, TransientFault
+from .regrow import (
+    GROWABLE,
+    grown,
+    migrate_engine_carry,
+    migrate_shard_carry,
+)
+
+# exception types treated as transient (retried with backoff); the
+# injected stand-in plus whatever XLA runtime error type this jax exposes
+_TRANSIENT: tuple = (TransientFault,)
+try:  # pragma: no cover - depends on the installed jaxlib
+    from jax.errors import JaxRuntimeError
+
+    _TRANSIENT = (TransientFault, JaxRuntimeError)
+except ImportError:  # pragma: no cover
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+
+        _TRANSIENT = (TransientFault, XlaRuntimeError)
+    except ImportError:
+        pass
+
+# CLI exit code for an interrupted-but-checkpointed run (EX_TEMPFAIL:
+# "try again later" - distinct from 0/12/13 so schedulers can requeue)
+EXIT_INTERRUPTED = 75
+
+
+class SlotOverflowError(RuntimeError):
+    """Codec slot overflow: a state field exceeded its compiled bit
+    width.  Not survivable by regrow - the codec/kernel must be rebuilt
+    with wider ModelConfig bounds - so the supervisor checkpoints the
+    last good carry and raises this with the resume story attached."""
+
+    def __init__(self, ckpt_path: Optional[str]):
+        self.ckpt_path = ckpt_path
+        hint = (
+            f"; last good carry checkpointed at {ckpt_path!r} - after "
+            "raising the bounds, restart (a recompiled codec changes the "
+            "state encoding, so the checkpoint is diagnostic only)"
+            if ckpt_path else "; re-run with -checkpoint to keep a snapshot"
+        )
+        super().__init__(
+            "codec slot overflow: raise the ModelConfig bounds and "
+            "recompile - auto-grow cannot widen compiled bit fields" + hint
+        )
+
+
+@dataclasses.dataclass
+class SupervisorOptions:
+    """Knobs of one supervised run (CLI: -auto-grow/-no-auto-grow,
+    -max-regrow, -retry, -checkpoint, -checkpointevery, -recover)."""
+
+    auto_grow: bool = True
+    max_regrow: int = 8
+    retries: int = 2
+    backoff_base_s: float = 0.5
+    backoff_cap_s: float = 30.0
+    ckpt_path: Optional[str] = None
+    ckpt_every: int = 256
+    keep_generations: int = 2
+    resume: bool = False
+    faults: Optional[FaultPlan] = None
+    # on_event(kind, info_dict): checkpoint / ckpt_write_failed / recovery
+    # / regrow / retry / interrupted / progress - the tlc_log banner seam
+    on_event: Optional[Callable[[str, dict], None]] = None
+
+
+class SupervisedResult(NamedTuple):
+    result: CheckResult
+    params: dict  # final engine geometry (auto-grown values included)
+    regrows: int
+    retries: int
+    interrupted: bool
+    segments: int
+    ckpt_writes: int
+    ckpt_write_s: float  # total seconds spent writing checkpoints
+    regrow_s: float  # total seconds spent in regrow migration + rebuild
+
+
+class _SignalCatcher:
+    """Installs SIGTERM/SIGINT handlers that record the signal instead of
+    killing the process, so the supervision loop can drain the current
+    segment and checkpoint.  Restores previous handlers on exit; degrades
+    to a no-op off the main thread (signal.signal raises there)."""
+
+    SIGNUMS = (signal.SIGTERM, signal.SIGINT)
+
+    def __enter__(self):
+        self.hit = None
+        self._saved = {}
+        for s in self.SIGNUMS:
+            try:
+                self._saved[s] = signal.signal(
+                    s, lambda signum, frame: self._record(signum)
+                )
+            except ValueError:  # not the main thread
+                pass
+        return self
+
+    def _record(self, signum):
+        self.hit = signum
+
+    def __exit__(self, *exc):
+        for s, h in self._saved.items():
+            signal.signal(s, h)
+        return False
+
+
+class SingleDeviceAdapter:
+    """Supervision seam over the single-device segmented engine
+    (engine.checkpoint's driver, reshaped so the supervisor owns the
+    loop).  Growable params: queue_capacity, fp_capacity."""
+
+    kind = "single"
+    GEOM_KEYS = ("queue_capacity", "fp_capacity")
+    FIXED_KEYS = ("format", "config", "chunk", "fp_index", "seed",
+                  "fp_highwater")
+
+    def __init__(self, cfg, chunk: int = 1024,
+                 fp_index: int = DEFAULT_FP_INDEX, seed: int = DEFAULT_SEED,
+                 fp_highwater: float = DEFAULT_FP_HIGHWATER):
+        self.cfg = cfg
+        self.chunk = chunk
+        self.fp_index = fp_index
+        self.seed = seed
+        self.fp_highwater = fp_highwater
+
+    def build(self, params: dict, ckpt_every: int):
+        init_fn, _, step_fn = make_engine(
+            self.cfg, self.chunk, params["queue_capacity"],
+            params["fp_capacity"], self.fp_index, self.seed,
+            fp_highwater=self.fp_highwater,
+        )
+
+        @jax.jit
+        def segment(c):
+            return lax.fori_loop(0, ckpt_every, lambda _, cc: step_fn(cc), c)
+
+        template = init_fn()
+        compiled = segment.lower(template).compile()
+        return template, lambda c: jax.block_until_ready(compiled(c))
+
+    def meta(self, params: dict) -> dict:
+        return ckpt._meta(
+            self.cfg, chunk=self.chunk, fp_index=self.fp_index,
+            seed=self.seed, fp_highwater=self.fp_highwater, **params,
+        )
+
+    def viol(self, carry) -> int:
+        return int(carry.viol)
+
+    def done(self, carry) -> bool:
+        return carry_done(carry)
+
+    def progress(self, carry):
+        return (
+            int(carry.depth), int(carry.generated), int(carry.distinct),
+            int(carry.level_n) - int(carry.qhead) + int(carry.next_n),
+        )
+
+    def migrate(self, carry, old_params: dict, new_params: dict):
+        return migrate_engine_carry(carry, old_params, new_params)
+
+    def result(self, carry, wall: float, segments: int,
+               params: dict) -> CheckResult:
+        from ..engine.fpset import fpset_actual_collision
+
+        afc = float(fpset_actual_collision(carry.fps))
+        return result_from_carry(
+            carry, wall, iterations=segments,
+            fp_capacity=params["fp_capacity"],
+        )._replace(actual_fp_collision=afc)
+
+
+class ShardedAdapter:
+    """Supervision seam over the mesh-sharded engine.  All capacities are
+    PER DEVICE; route_factor regrows without carry migration."""
+
+    kind = "sharded"
+    GEOM_KEYS = ("queue_capacity", "fp_capacity", "route_factor")
+    FIXED_KEYS = ("format", "config", "devices", "fp_highwater")
+
+    def __init__(self, cfg, mesh, chunk: int = 512, backend=None,
+                 meta_config: dict = None,
+                 fp_highwater: float = DEFAULT_FP_HIGHWATER):
+        from ..engine.sharded import kubeapi_backend
+
+        self.cfg = cfg
+        self.mesh = mesh
+        self.chunk = chunk
+        self.backend = backend if backend is not None else kubeapi_backend(cfg)
+        self.meta_config = meta_config
+        self.fp_highwater = fp_highwater
+
+    def build(self, params: dict, ckpt_every: int):
+        from ..engine.sharded import make_sharded_engine
+
+        init_fn, seg_fn = make_sharded_engine(
+            self.cfg, self.mesh, self.chunk,
+            params["queue_capacity"], params["fp_capacity"],
+            route_factor=params["route_factor"], segment=ckpt_every,
+            backend=self.backend, fp_highwater=self.fp_highwater,
+        )
+        template = init_fn()
+        compiled = seg_fn.lower(template).compile()
+        return template, lambda c: jax.block_until_ready(compiled(c))
+
+    def meta(self, params: dict) -> dict:
+        return ckpt._meta(
+            self.cfg, meta_config=self.meta_config, chunk=self.chunk,
+            devices=int(self.mesh.devices.size),
+            fp_highwater=self.fp_highwater, **params,
+        )
+
+    def viol(self, carry) -> int:
+        return int(np.asarray(carry.viol).max())
+
+    def done(self, carry) -> bool:
+        return not bool(np.asarray(carry.cont).any())
+
+    def progress(self, carry):
+        return (
+            int(np.asarray(carry.depth).max()),
+            int(np.asarray(carry.generated).sum()),
+            int(np.asarray(carry.distinct).sum()),
+            int((np.asarray(carry.qtail) - np.asarray(carry.qhead)).sum()),
+        )
+
+    def migrate(self, carry, old_params: dict, new_params: dict):
+        return migrate_shard_carry(carry, old_params, new_params)
+
+    def result(self, carry, wall: float, segments: int,
+               params: dict) -> CheckResult:
+        from ..engine.sharded import result_from_shard_carry
+
+        return result_from_shard_carry(
+            carry, wall, iterations=segments,
+            labels=self.backend.labels,
+            viol_names=self.backend.viol_names,
+            fp_capacity_total=(
+                params["fp_capacity"] * int(self.mesh.devices.size)
+            ),
+        )
+
+
+def _params_from_meta(adapter, meta: dict, params: dict) -> dict:
+    """Resume geometry resolution: fixed keys (config, codec-shaping
+    parameters) must match what this process would write; growable
+    geometry keys are TAKEN FROM THE CHECKPOINT (auto-grown capacities
+    travel with the snapshot, so the resume command needs none of them)."""
+    want = adapter.meta(params)
+    for key in adapter.FIXED_KEYS:
+        if meta.get(key) != want.get(key):
+            raise ValueError(
+                f"checkpoint {key} mismatch: "
+                f"{meta.get(key)!r} != {want.get(key)!r}"
+            )
+    out = dict(params)
+    for key in adapter.GEOM_KEYS:
+        if key in meta:
+            out[key] = meta[key]
+    return out
+
+
+def _emit(opts: SupervisorOptions, kind: str, **info) -> None:
+    if opts.on_event is not None:
+        opts.on_event(kind, info)
+
+
+def _resume(adapter, params: dict, opts: SupervisorOptions):
+    """Load the newest verifiable checkpoint of the family `ckpt_path`
+    (generations first, then the plain file for pre-supervisor
+    snapshots), rebuilding the engine with the recorded geometry.
+    Returns (params, template, seg_fn, carry, path)."""
+    base = opts.ckpt_path
+    cands = [p for _, p in reversed(ckpt.list_generations(base))]
+    if os.path.exists(base):
+        cands.append(base)
+    if not cands:
+        raise FileNotFoundError(f"no checkpoint at {base!r}")
+    last_err = None
+    for path in cands:
+        try:
+            meta = ckpt.read_checkpoint_meta(path)
+        except ckpt.CheckpointCorruptError as e:
+            last_err = e
+            _emit(opts, "ckpt_fallback", path=path, error=str(e))
+            continue
+        new_params = _params_from_meta(adapter, meta, params)
+        template, seg_fn = adapter.build(new_params, opts.ckpt_every)
+        try:
+            _, carry = ckpt.load_checkpoint(path, template)
+        except ckpt.CheckpointCorruptError as e:
+            last_err = e
+            _emit(opts, "ckpt_fallback", path=path, error=str(e))
+            continue
+        return new_params, template, seg_fn, carry, path
+    raise FileNotFoundError(
+        f"no intact checkpoint under {base!r} (newest failure: {last_err})"
+    )
+
+
+def supervise(adapter, params: dict,
+              opts: SupervisorOptions = None) -> SupervisedResult:
+    """Run an exhaustive check under supervision.  `params` holds the
+    adapter's growable geometry (queue_capacity, fp_capacity, and
+    route_factor for the sharded adapter); everything else is fixed in
+    the adapter.  Returns the final CheckResult plus recovery telemetry.
+    """
+    opts = opts or SupervisorOptions()
+    faults = FaultInjector(opts.faults)
+    rng = random.Random(0xC0FFEE)  # deterministic backoff jitter
+    params = dict(params)
+    regrows = retries_used = segments = ckpt_writes = 0
+    ckpt_write_s = regrow_s = 0.0
+    interrupted = False
+
+    if opts.resume:
+        if not opts.ckpt_path:
+            raise ValueError("resume requires a checkpoint path")
+        params, template, seg_fn, carry, path = _resume(
+            adapter, params, opts
+        )
+        prog = adapter.progress(carry)
+        _emit(opts, "recovery", path=path, depth=prog[0],
+              generated=prog[1], distinct=prog[2], queue=prog[3])
+    else:
+        template, seg_fn = adapter.build(params, opts.ckpt_every)
+        carry = template
+    # timer starts after the (AOT) build, matching bfs.check's discipline
+    # (regrow rebuilds DO count: recompilation is part of regrow's price)
+    t0 = time.time()
+
+    def save(carry_to_save, label: str):
+        nonlocal ckpt_writes, ckpt_write_s
+        if not opts.ckpt_path:
+            return None
+        faults.before_write()
+        t = time.time()
+        path = ckpt.save_generation(
+            opts.ckpt_path, carry_to_save, adapter.meta(params),
+            keep=opts.keep_generations,
+        )
+        # refresh the plain family head too (hardlink, no data copy):
+        # non-supervised tooling and the TLC `-recover` muscle memory
+        # expect the checkpoint to exist under the path the user gave
+        tmp = opts.ckpt_path + ".head.tmp"
+        try:
+            os.link(path, tmp)
+            os.replace(tmp, opts.ckpt_path)
+        except OSError:
+            try:
+                import shutil
+
+                shutil.copyfile(path, tmp)
+                os.replace(tmp, opts.ckpt_path)
+            except OSError:
+                pass
+        ckpt_write_s += time.time() - t
+        ckpt_writes += 1
+        faults.after_write(path)
+        _emit(opts, "checkpoint", path=path,
+              seconds=round(time.time() - t, 3), label=label)
+        return path
+
+    good = carry
+    with _SignalCatcher() as sig:
+        while not adapter.done(carry):
+            if sig.hit is not None:
+                interrupted = True
+                break
+
+            # ---- one segment, with retry/backoff around transients ----
+            attempt = 0
+            while True:
+                try:
+                    faults.segment_start(segments)
+                    carry2 = seg_fn(good)
+                    break
+                except _TRANSIENT as e:
+                    if attempt >= opts.retries:
+                        raise
+                    delay = min(
+                        opts.backoff_cap_s,
+                        opts.backoff_base_s * (2 ** attempt),
+                    ) * (0.5 + rng.random())
+                    _emit(opts, "retry", attempt=attempt + 1,
+                          delay_s=round(delay, 3), error=str(e))
+                    time.sleep(delay)
+                    attempt += 1
+                    retries_used += 1
+                    # restore from the last good on-disk snapshot when one
+                    # exists (device state may be gone after a real device
+                    # error); otherwise retry from the in-memory good carry
+                    if opts.ckpt_path and ckpt.list_generations(
+                        opts.ckpt_path
+                    ):
+                        try:
+                            _, _, good = ckpt.load_latest_generation(
+                                opts.ckpt_path, template
+                            )
+                        except FileNotFoundError:
+                            pass
+
+            v = adapter.viol(carry2)
+            if v in GROWABLE:
+                resource = GROWABLE[v]
+                if not opts.auto_grow or regrows >= opts.max_regrow:
+                    carry = carry2  # report the halt as-is
+                    break
+                new_params = grown(params, resource)
+                t = time.time()
+                if resource == "route_factor":
+                    migrated = good  # engine-geometry-only knob
+                else:
+                    migrated = adapter.migrate(good, params, new_params)
+                template, seg_fn = adapter.build(
+                    new_params, opts.ckpt_every
+                )
+                regrow_s += time.time() - t
+                regrows += 1
+                _emit(opts, "regrow", resource=resource,
+                      old=params[resource], new=new_params[resource],
+                      violation=VIOLATION_NAMES.get(v, str(v)),
+                      regrows=regrows,
+                      seconds=round(time.time() - t, 3))
+                params = new_params
+                good = migrated
+                carry = migrated
+                continue  # replay the segment inside the new geometry
+
+            if v == VIOL_SLOT_OVERFLOW:
+                path = None
+                try:
+                    path = save(good, "slot-overflow")
+                except OSError:
+                    pass
+                raise SlotOverflowError(path)
+
+            carry = carry2
+            good = carry2
+            segments += 1
+            if opts.ckpt_path:
+                try:
+                    save(good, "periodic")
+                except OSError as e:
+                    # a failed snapshot write must not kill a healthy
+                    # run; the next segment boundary retries
+                    _emit(opts, "ckpt_write_failed", error=str(e))
+            if adapter.viol(carry) == OK and not adapter.done(carry):
+                d, g, di, q = adapter.progress(carry)
+                _emit(opts, "progress", depth=d, generated=g,
+                      distinct=di, queue=q)
+
+        if interrupted:
+            path = None
+            try:
+                path = save(good, "final")
+            except OSError as e:
+                _emit(opts, "ckpt_write_failed", error=str(e))
+            _emit(opts, "interrupted",
+                  signum=int(sig.hit) if sig.hit else None, path=path)
+
+    result = adapter.result(carry, time.time() - t0, segments, params)
+    return SupervisedResult(
+        result=result,
+        params=params,
+        regrows=regrows,
+        retries=retries_used,
+        interrupted=interrupted,
+        segments=segments,
+        ckpt_writes=ckpt_writes,
+        ckpt_write_s=round(ckpt_write_s, 6),
+        regrow_s=round(regrow_s, 6),
+    )
+
+
+def check_supervised(
+    cfg,
+    chunk: int = 1024,
+    queue_capacity: int = 1 << 15,
+    fp_capacity: int = 1 << 20,
+    fp_index: int = DEFAULT_FP_INDEX,
+    seed: int = DEFAULT_SEED,
+    fp_highwater: float = DEFAULT_FP_HIGHWATER,
+    opts: SupervisorOptions = None,
+) -> SupervisedResult:
+    """Supervised single-device exhaustive check (the check_with_
+    checkpoints signature, plus self-healing)."""
+    adapter = SingleDeviceAdapter(
+        cfg, chunk=chunk, fp_index=fp_index, seed=seed,
+        fp_highwater=fp_highwater,
+    )
+    return supervise(
+        adapter,
+        {"queue_capacity": queue_capacity, "fp_capacity": fp_capacity},
+        opts,
+    )
+
+
+def check_sharded_supervised(
+    cfg,
+    mesh,
+    chunk: int = 512,
+    queue_capacity: int = 1 << 14,
+    fp_capacity: int = 1 << 18,
+    route_factor: float = 2.0,
+    backend=None,
+    meta_config: dict = None,
+    fp_highwater: float = DEFAULT_FP_HIGHWATER,
+    opts: SupervisorOptions = None,
+) -> SupervisedResult:
+    """Supervised mesh-sharded exhaustive check (capacities PER DEVICE)."""
+    adapter = ShardedAdapter(
+        cfg, mesh, chunk=chunk, backend=backend, meta_config=meta_config,
+        fp_highwater=fp_highwater,
+    )
+    return supervise(
+        adapter,
+        {
+            "queue_capacity": queue_capacity,
+            "fp_capacity": fp_capacity,
+            "route_factor": route_factor,
+        },
+        opts,
+    )

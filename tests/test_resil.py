@@ -1,0 +1,330 @@
+"""Resilience tests: every supervisor recovery path recovers to EXACT
+clean-run statistics (ISSUE 2 acceptance criteria).
+
+- auto-regrow from deliberately undersized capacities == correctly-sized
+  clean run, state-for-state (FF corner full-signature; Model_1 against
+  the committed reference counts, MC.out:1098,1101);
+- SIGTERM at segment K -> drain + final checkpoint -> resume -> identical
+  final counts, THROUGH a truncated (torn) newest generation;
+- transient segment errors absorbed by retry/backoff; failed checkpoint
+  writes don't kill a healthy run;
+- CRC manifest detects corruption; generation fallback prefers the newest
+  intact snapshot; CapacityError carries occupancy/capacity.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from jaxtlc.config import MODEL_1, ModelConfig
+from jaxtlc.engine import checkpoint as ck
+from jaxtlc.engine.bfs import VIOL_SLOT_OVERFLOW, check
+from jaxtlc.engine.fpset import BUCKET, CapacityError, host_insert
+from jaxtlc.resil import (
+    FaultPlan,
+    SlotOverflowError,
+    SupervisorOptions,
+    check_supervised,
+    supervise,
+)
+from jaxtlc.resil.faults import FaultInjector, TransientFault, truncate_file
+
+FF = ModelConfig(False, False)
+EXPECT_FF = (17020, 8203, 109)
+EXPECT_M1 = (577736, 163408, 124)  # MC.out:1098,1101
+KW = dict(chunk=128, queue_capacity=1 << 12, fp_capacity=1 << 14)
+
+
+def signature(r):
+    """Full exactness signature of a CheckResult."""
+    return (r.generated, r.distinct, r.depth, r.violation,
+            tuple(sorted(r.action_generated.items())),
+            tuple(sorted(r.action_distinct.items())),
+            r.outdegree)
+
+
+@pytest.fixture(scope="module")
+def clean_ff():
+    return check(FF, **KW)
+
+
+def test_regrow_undersized_matches_clean_exactly(clean_ff):
+    # fp 2^11 and queue 2^8 are both too small for 8203 distinct states /
+    # the widest BFS level; the supervisor must double its way out and
+    # still match the correctly-sized fused run on EVERY statistic
+    sr = check_supervised(
+        FF, chunk=128, queue_capacity=1 << 8, fp_capacity=1 << 11,
+        opts=SupervisorOptions(ckpt_every=8),
+    )
+    assert sr.regrows >= 1 and not sr.interrupted
+    assert sr.params["fp_capacity"] > (1 << 11)
+    assert signature(sr.result) == signature(clean_ff)
+
+
+def test_regrow_model1_acceptance():
+    # the ISSUE acceptance criterion: a deliberately undersized Model_1
+    # run completes via auto-regrow with final distinct-state and
+    # diameter counts identical to the committed correctly-sized
+    # reference run (MC.out); occupancy lands on the result
+    sr = check_supervised(
+        MODEL_1, chunk=1024, queue_capacity=1 << 9, fp_capacity=1 << 17,
+        opts=SupervisorOptions(ckpt_every=64),
+    )
+    r = sr.result
+    assert sr.regrows >= 2
+    assert (r.generated, r.distinct, r.depth) == EXPECT_M1
+    assert r.violation == 0 and r.queue_left == 0
+    assert r.fp_occupancy == pytest.approx(
+        163408 / sr.params["fp_capacity"]
+    )
+    # BOTH resources must have grown: the fp table (2^17 -> 2^18) and the
+    # frontier queue (512 was undersized: TLC's 906-states-on-queue
+    # Progress line, MC.out:35, is a snapshot - the true peak BFS level
+    # is wider still)
+    assert sr.params["fp_capacity"] == 1 << 18
+    assert sr.params["queue_capacity"] > 1 << 9
+
+
+def test_sharded_regrow_matches_clean():
+    # the mesh adapter: per-device fp saturation regrows and still matches
+    # a correctly-sized SHARDED clean run exactly (in-batch duplicate
+    # attribution is routing-order-dependent, so the sharded engine is its
+    # own attribution baseline; counts/depth equal the fused engine's as
+    # ever).  Queue + route migration on the mesh are exercised by the
+    # wider chaos sweep in tools/chaos.py scenarios.
+    import jax
+    from jax.sharding import Mesh
+
+    from jaxtlc.engine.sharded import check_sharded
+    from jaxtlc.resil import check_sharded_supervised
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("fp",))
+    clean = check_sharded(
+        FF, mesh, chunk=128, queue_capacity=1 << 11, fp_capacity=1 << 14
+    )
+    assert (clean.generated, clean.distinct, clean.depth) == EXPECT_FF
+    sr = check_sharded_supervised(
+        FF, mesh, chunk=128, queue_capacity=1 << 11,
+        fp_capacity=1 << 12,  # per device: too small for ~4100/device
+        opts=SupervisorOptions(ckpt_every=8),
+    )
+    r = sr.result
+    assert sr.regrows >= 1
+    assert (r.generated, r.distinct, r.depth) == EXPECT_FF
+    assert r.action_distinct == clean.action_distinct
+    assert r.action_generated == clean.action_generated
+
+
+def test_sigterm_truncate_resume_exact(tmp_path, clean_ff):
+    p = str(tmp_path / "ck.npz")
+    events = []
+    sr = check_supervised(
+        FF,
+        opts=SupervisorOptions(
+            ckpt_path=p, ckpt_every=8,
+            faults=FaultPlan.parse("sigterm@2"),
+            on_event=lambda k, i: events.append(k),
+        ),
+        **KW,
+    )
+    assert sr.interrupted and "interrupted" in events
+    assert sr.result.queue_left > 0  # genuinely unfinished
+    gens = ck.list_generations(p)
+    assert gens, "drain must leave checkpoint generations"
+    assert os.path.exists(p)  # plain family head maintained too
+    meta = ck.read_checkpoint_meta(gens[-1][1])
+    assert meta["format"] == ck.FORMAT_VERSION
+    assert meta["fp_highwater"] == 0.85  # recorded in checkpoint meta
+
+    # tear the newest generation: resume must fall back to the previous
+    # one and still reach the exact clean-run statistics
+    truncate_file(gens[-1][1])
+    events2 = []
+    sr2 = check_supervised(
+        FF,
+        opts=SupervisorOptions(
+            ckpt_path=p, ckpt_every=64, resume=True,
+            on_event=lambda k, i: events2.append(k),
+        ),
+        **KW,
+    )
+    assert "ckpt_fallback" in events2 and "recovery" in events2
+    assert not sr2.interrupted
+    assert signature(sr2.result) == signature(clean_ff)
+
+
+def test_transient_retry_and_failed_write(tmp_path, clean_ff):
+    p = str(tmp_path / "ck.npz")
+    events = []
+    sr = check_supervised(
+        FF,
+        opts=SupervisorOptions(
+            ckpt_path=p, ckpt_every=8, backoff_base_s=0.01,
+            faults=FaultPlan.parse("transient@1,write_fail@2"),
+            on_event=lambda k, i: events.append(k),
+        ),
+        **KW,
+    )
+    assert sr.retries == 1 and "retry" in events
+    assert "ckpt_write_failed" in events  # run survived the bad write
+    assert signature(sr.result) == signature(clean_ff)
+
+
+# ---- storage-tier units (no engine builds: dict pytrees) -----------------
+
+
+def _fake_carry():
+    return {
+        "a": np.arange(7, dtype=np.uint32),
+        "b": np.ones((3, 2), np.int32),
+    }
+
+
+def test_crc_manifest_detects_corruption(tmp_path):
+    p = str(tmp_path / "c.npz")
+    carry = _fake_carry()
+    ck.save_checkpoint(p, carry, {"x": 1})
+    meta, loaded = ck.load_checkpoint(p, carry)
+    assert meta["x"] == 1 and "manifest" in meta
+    assert all(
+        (np.asarray(a) == np.asarray(b)).all()
+        for a, b in zip(carry.values(), loaded.values())
+    )
+    # flip bytes in the middle of the file: CRC (or the zip layer) must
+    # refuse, never return garbage arrays
+    data = bytearray(open(p, "rb").read())
+    mid = len(data) // 2
+    data[mid:mid + 8] = b"\xff" * 8
+    open(p, "wb").write(bytes(data))
+    with pytest.raises(ck.CheckpointCorruptError):
+        ck.load_checkpoint(p, carry)
+    # truncation (the torn-write shape) is also detected
+    ck.save_checkpoint(p, carry, {"x": 1})
+    truncate_file(p)
+    with pytest.raises(ck.CheckpointCorruptError):
+        ck.load_checkpoint(p, carry)
+
+
+def test_generations_prune_and_fallback(tmp_path):
+    base = str(tmp_path / "g.npz")
+    carry = _fake_carry()
+    for i in range(3):
+        carry["a"] = carry["a"] + np.uint32(1)
+        ck.save_generation(base, carry, {"i": i}, keep=2)
+    gens = ck.list_generations(base)
+    assert [g for g, _ in gens] == [2, 3]  # pruned to the newest 2
+    path, meta, loaded = ck.load_latest_generation(base, carry)
+    assert meta["i"] == 2 and path.endswith(".g000003.npz")
+    truncate_file(gens[-1][1])
+    path, meta, _ = ck.load_latest_generation(base, carry)
+    assert meta["i"] == 1 and path.endswith(".g000002.npz")
+
+
+def test_capacity_error_is_structured():
+    table = np.zeros((1, 2 * BUCKET), np.uint32)
+    for i in range(BUCKET):
+        assert host_insert(table, i + 1, 0xABC0 + i)
+    with pytest.raises(CapacityError) as ei:
+        host_insert(table, 999, 0xDEAD)
+    assert ei.value.occupancy == BUCKET
+    assert ei.value.capacity == BUCKET
+    assert ei.value.resource == "fpset"
+
+
+def test_fault_plan_parse():
+    plan = FaultPlan.parse("write_fail@2, sigterm@3,transient@1")
+    assert plan.write_fail == {2} and plan.sigterm == {3}
+    assert plan.transient == {1} and plan.truncate == frozenset()
+    with pytest.raises(ValueError):
+        FaultPlan.parse("explode@1")
+    inj = FaultInjector(FaultPlan.parse("transient@0"))
+    with pytest.raises(TransientFault):
+        inj.segment_start(0)
+    inj.segment_start(0)  # each fault fires exactly once
+
+
+def test_occupancy_on_result(clean_ff):
+    assert clean_ff.fp_occupancy == pytest.approx(8203 / (1 << 14))
+
+
+# ---- slot overflow degrades to checkpoint + actionable error -------------
+
+
+class _StubAdapter:
+    """Pure-python adapter: segment 0 'runs' fine, segment 1 reports a
+    codec slot overflow.  Proves the supervisor degrades it to a final
+    checkpoint of the last good carry + SlotOverflowError, not a bare
+    abort (real slot overflow needs a spec whose bounds overflow, which
+    no committed config does)."""
+
+    kind = "stub"
+    GEOM_KEYS = ()
+    FIXED_KEYS = ("format",)
+
+    def __init__(self):
+        self.calls = 0
+
+    def build(self, params, ckpt_every):
+        template = {"x": np.zeros(4, np.int32), "viol": np.int32(0)}
+
+        def seg(c):
+            self.calls += 1
+            out = dict(c)
+            out["x"] = c["x"] + 1
+            if self.calls >= 2:
+                out["viol"] = np.int32(VIOL_SLOT_OVERFLOW)
+            return out
+
+        return template, seg
+
+    def meta(self, params):
+        return {"format": ck.FORMAT_VERSION}
+
+    def viol(self, carry):
+        return int(carry["viol"])
+
+    def done(self, carry):
+        return False
+
+    def progress(self, carry):
+        return (0, 0, 0, 0)
+
+    def migrate(self, carry, old, new):  # pragma: no cover
+        raise AssertionError("slot overflow must not try to regrow")
+
+    def result(self, carry, wall, segments, params):  # pragma: no cover
+        raise AssertionError("unreachable")
+
+
+def test_slot_overflow_degrades_to_checkpoint(tmp_path):
+    base = str(tmp_path / "so.npz")
+    with pytest.raises(SlotOverflowError) as ei:
+        supervise(
+            _StubAdapter(), {},
+            SupervisorOptions(ckpt_path=base, ckpt_every=1),
+        )
+    assert "recompile" in str(ei.value)
+    assert ei.value.ckpt_path is not None
+    # the persisted carry is the LAST GOOD one (segment 1's output)
+    gens = ck.list_generations(base)
+    template = {"x": np.zeros(4, np.int32), "viol": np.int32(0)}
+    _, _, carry = ck.load_latest_generation(base, template)
+    assert (np.asarray(carry["x"]) == 1).all()
+    assert int(carry["viol"]) == 0
+
+
+# ---- chaos smoke (tools/chaos.py wired into tier-1) ----------------------
+
+
+def test_chaos_smoke():
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos", _os.path.join(_os.path.dirname(__file__), _os.pardir,
+                               "tools", "chaos.py")
+    )
+    chaos = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos)
+    assert chaos.run_scenarios(verbose=False) == 0

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Chaos driver: prove the supervisor's recovery paths by fault injection.
+
+Runs a clean supervised reference run, then a series of faulted runs -
+each exercising one recovery path (auto-regrow from undersized
+capacities, transient-error retry, failed checkpoint write, SIGTERM
+drain + resume, torn-newest-checkpoint generation fallback) - and
+verifies that every recovered run's final statistics match the clean
+run's EXACTLY (generated, distinct, depth, per-action counts,
+outdegree).  Any mismatch is a checker bug, reported loudly with exit 1.
+
+Usage:
+    python tools/chaos.py --smoke         # fast fixed plan, CPU, FF corner
+    python tools/chaos.py --plan PLAN     # custom fault plan (faults DSL)
+    python tools/chaos.py --seed-caps     # also run the undersized-regrow
+                                          # scenario from 1/8 capacities
+
+The smoke mode is wired into tier-1 (tests/test_resil.py::test_chaos_smoke)
+so every recovery path stays proven on every run of the suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _sig(r):
+    """The exactness signature of a CheckResult."""
+    return (r.generated, r.distinct, r.depth, r.violation,
+            tuple(sorted(r.action_generated.items())),
+            tuple(sorted(r.action_distinct.items())),
+            r.outdegree)
+
+
+def run_scenarios(plan_spec: str = "", verbose: bool = True) -> int:
+    """Returns 0 when every faulted run recovered to the clean run's exact
+    statistics; 1 otherwise."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jaxtlc.config import ModelConfig
+    from jaxtlc.resil import (
+        FaultPlan,
+        SupervisorOptions,
+        check_supervised,
+    )
+    from jaxtlc.resil.faults import truncate_file
+    from jaxtlc.engine.checkpoint import list_generations
+
+    cfg = ModelConfig(False, False)  # FF corner: 17020/8203/109
+    KW = dict(chunk=128, queue_capacity=1 << 12, fp_capacity=1 << 14)
+
+    def say(msg):
+        if verbose:
+            print(f"[chaos] {msg}", flush=True)
+
+    say("clean reference run...")
+    clean = check_supervised(
+        cfg, opts=SupervisorOptions(ckpt_every=8), **KW
+    )
+    want = _sig(clean.result)
+    say(f"clean: generated={clean.result.generated} "
+        f"distinct={clean.result.distinct} depth={clean.result.depth}")
+
+    failures = []
+
+    def verify(name, sr):
+        got = _sig(sr.result)
+        if got != want:
+            failures.append(name)
+            say(f"FAIL {name}: {got} != {want}")
+        else:
+            say(f"ok   {name} (regrows={sr.regrows} retries={sr.retries})")
+
+    with tempfile.TemporaryDirectory() as d:
+        # 1. undersized capacities -> auto-regrow to completion
+        caps = dict(chunk=128, queue_capacity=1 << 9,
+                    fp_capacity=1 << 11)
+        say("scenario: auto-regrow from undersized capacities...")
+        sr = check_supervised(
+            cfg, opts=SupervisorOptions(ckpt_every=8), **caps
+        )
+        if sr.regrows == 0:
+            failures.append("regrow(no regrow happened)")
+        verify("regrow", sr)
+
+        # 2. transient error in a segment -> backoff retry
+        say("scenario: transient error at segment 1 + "
+            "failed checkpoint write...")
+        p2 = os.path.join(d, "t.npz")
+        sr = check_supervised(
+            cfg,
+            opts=SupervisorOptions(
+                ckpt_path=p2, ckpt_every=8, backoff_base_s=0.01,
+                faults=FaultPlan.parse("transient@1,write_fail@2"),
+            ),
+            **KW,
+        )
+        if sr.retries != 1:
+            failures.append("retry(no retry happened)")
+        verify("transient+write_fail", sr)
+
+        # 3. SIGTERM at segment 2 -> drain + final checkpoint; truncate the
+        #    newest generation (torn write); resume falls back + completes
+        say("scenario: SIGTERM drain, torn newest checkpoint, resume...")
+        p3 = os.path.join(d, "s.npz")
+        sr = check_supervised(
+            cfg,
+            opts=SupervisorOptions(
+                ckpt_path=p3, ckpt_every=8,
+                faults=FaultPlan.parse("sigterm@2"),
+            ),
+            **KW,
+        )
+        if not sr.interrupted:
+            failures.append("sigterm(run was not interrupted)")
+        gens = list_generations(p3)
+        if not gens:
+            failures.append("sigterm(no checkpoint generations)")
+        else:
+            truncate_file(gens[-1][1])
+            sr = check_supervised(
+                cfg,
+                opts=SupervisorOptions(
+                    ckpt_path=p3, ckpt_every=32, resume=True,
+                ),
+                **KW,
+            )
+            verify("sigterm+truncate+resume", sr)
+
+        # 4. optional custom plan (--plan) against a fresh checkpoint family
+        if plan_spec:
+            say(f"scenario: custom plan {plan_spec!r}...")
+            p4 = os.path.join(d, "c.npz")
+            sr = check_supervised(
+                cfg,
+                opts=SupervisorOptions(
+                    ckpt_path=p4, ckpt_every=8, backoff_base_s=0.01,
+                    faults=FaultPlan.parse(plan_spec),
+                ),
+                **KW,
+            )
+            if sr.interrupted:
+                sr = check_supervised(
+                    cfg,
+                    opts=SupervisorOptions(
+                        ckpt_path=p4, ckpt_every=32, resume=True,
+                    ),
+                    **KW,
+                )
+            verify(f"custom({plan_spec})", sr)
+
+    if failures:
+        say(f"FAILURES: {failures}")
+        return 1
+    say("all recovery paths recovered to exact clean-run statistics")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="fault-injection chaos driver for the run supervisor"
+    )
+    p.add_argument("--smoke", action="store_true",
+                   help="fast fixed-plan CPU run (the tier-1 wiring)")
+    p.add_argument("--plan", default="",
+                   help="extra fault plan DSL for a custom scenario")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+    return run_scenarios(plan_spec=args.plan, verbose=not args.quiet)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
