@@ -26,6 +26,9 @@ Usage:
     python bench.py --model1   # Model_1 exhaustive (the TLC-comparable
                                # workload) on whatever device is up
     python bench.py --scaled   # force the scaled workload
+    python bench.py --struct   # struct-compiled workload: cold + warm
+                               # (persistent compile cache) runs; emits
+                               # distinct_states_per_s + struct_warm_start_s
 """
 
 import json
@@ -212,6 +215,126 @@ def bench_resil(probe_err: str) -> int:
     return 0
 
 
+def bench_struct(probe_err: str) -> int:
+    """--struct: throughput + warm-start wall time of the struct path.
+
+    Runs the struct-compiled workload TWICE in fresh subprocesses
+    sharing one persistent compile-cache directory: the first (cold)
+    pays the full parse -> lane-compile -> XLA compile pipeline, the
+    second (warm) hits the on-disk XLA cache - the honest cross-process
+    warm-start figure.  Counts are gated both times; emits a
+    `struct_warm_start_s` line and the `distinct_states_per_s` line
+    (device provenance included so a CPU fallback stays visible)."""
+    import json as _json
+    import os
+    import subprocess
+    import tempfile
+
+    device_note = ""
+    if probe_err:
+        device_note = f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
+    ref = "/root/reference/KubeAPI.toolbox/Model_1/MC.cfg"
+    if os.path.exists(ref) and not probe_err:
+        workload, expect = "Model_1_struct", EXPECT["Model_1"]
+        plan = dict(cfg=ref, overrides=None, chunk=1024, qcap=1 << 15,
+                    fpcap=1 << 20, nodeadlock=False)
+    elif os.path.exists(ref):
+        # CPU fallback with the reference mounted: the FF corner (full
+        # Model_1 takes ~10 CPU-minutes per run - past a driver budget)
+        workload, expect = "Model_1_FF_struct", (17020, 8203, 109)
+        plan = dict(cfg=ref, chunk=512, qcap=1 << 14, fpcap=1 << 17,
+                    nodeadlock=False,
+                    overrides={"REQUESTS_CAN_FAIL": False,
+                               "REQUESTS_CAN_TIMEOUT": False})
+    else:
+        # reference not mounted: the bundled struct-frontend family
+        workload, expect = "TwoPhase_struct", (114, 56, 8)
+        plan = dict(cfg="specs/TwoPhase.toolbox/Model_1/MC.cfg",
+                    overrides=None, chunk=64, qcap=1 << 10,
+                    fpcap=1 << 12, nodeadlock=True)
+
+    child = (
+        "import json, os, time\n"
+        "t0 = time.time()\n"
+        "import jax\n"
+        "if os.environ.get('BENCH_FORCE_CPU'):\n"
+        "    jax.config.update('jax_platforms', 'cpu')\n"
+        "from jaxtlc.struct.loader import load\n"
+        "from jaxtlc.struct.engine import check_struct\n"
+        "p = json.loads(os.environ['BENCH_STRUCT'])\n"
+        "m = load(p['cfg'], const_overrides=p.get('overrides'))\n"
+        "r = check_struct(m, chunk=p['chunk'],\n"
+        "                 queue_capacity=p['qcap'],\n"
+        "                 fp_capacity=p['fpcap'],\n"
+        "                 check_deadlock=not p['nodeadlock'])\n"
+        "print(json.dumps({'generated': r.generated,\n"
+        "                  'distinct': r.distinct, 'depth': r.depth,\n"
+        "                  'violation': r.violation,\n"
+        "                  'wall_s': r.wall_s,\n"
+        "                  'total_s': time.time() - t0,\n"
+        "                  'device': str(jax.devices()[0])}))\n"
+    )
+    runs = []
+    with tempfile.TemporaryDirectory() as cache_dir:
+        env = dict(os.environ, BENCH_STRUCT=_json.dumps(plan),
+                   JAXTLC_COMPILE_CACHE=cache_dir)
+        if probe_err:
+            env["BENCH_FORCE_CPU"] = "1"
+        for label in ("cold", "warm"):
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", child], env=env, timeout=1800,
+                    capture_output=True, text=True,
+                )
+            except subprocess.TimeoutExpired:
+                _emit({"error": f"{label} struct run timed out",
+                       "workload": workload})
+                return 1
+            if proc.returncode != 0:
+                _emit({"error": f"{label} struct run failed: "
+                                f"{proc.stderr.strip().splitlines()[-1:]}",
+                       "workload": workload})
+                return 1
+            out = _json.loads(proc.stdout.strip().splitlines()[-1])
+            if out["violation"] or (
+                out["generated"], out["distinct"], out["depth"]
+            ) != expect:
+                _emit({"error": f"{label} count mismatch: "
+                                f"{(out['generated'], out['distinct'], out['depth'])}"
+                                f" != {expect}",
+                       "workload": workload})
+                return 1
+            runs.append(out)
+    cold, warm = runs
+    device = warm["device"] + device_note
+    _emit(
+        {
+            "metric": "struct_warm_start_s",
+            "value": round(warm["total_s"], 3),
+            "unit": "s",
+            "cold_start_s": round(cold["total_s"], 3),
+            "warm_over_cold": round(warm["total_s"] / cold["total_s"], 3),
+            "workload": workload,
+            "device": device,
+        }
+    )
+    rate = warm["distinct"] / warm["wall_s"]
+    _emit(
+        {
+            "value": round(rate, 1),
+            "vs_baseline": (round(rate / TLC_DISTINCT_PER_S, 2)
+                            if workload == "Model_1_struct" else 0),
+            "workload": workload,
+            "generated": warm["generated"],
+            "distinct": warm["distinct"],
+            "depth": warm["depth"],
+            "wall_s": round(warm["wall_s"], 3),
+            "device": device,
+        }
+    )
+    return 0
+
+
 def main() -> int:
     device_note = ""
     probe_err = _probe_backend()
@@ -219,6 +342,8 @@ def main() -> int:
         return bench_liveness(probe_err)
     if "--resil" in sys.argv:
         return bench_resil(probe_err)
+    if "--struct" in sys.argv:
+        return bench_struct(probe_err)
     if "--scaled" in sys.argv:
         scaled = True
     elif "--model1" in sys.argv:

@@ -365,6 +365,8 @@ def _resume_command(args) -> str:
         parts += ["-chunk", str(args.chunk)]
     if args.sharded:
         parts += ["-sharded", str(args.sharded)]
+    if args.frontend != "auto":
+        parts += ["-frontend", args.frontend]
     if not args.checkpoint:
         return ("re-run from scratch (no -checkpoint was set): "
                 + " ".join(parts))
@@ -505,7 +507,7 @@ def _run_check_gen(args, spec) -> int:
              (args.sharded or args.checkpoint)
              and not spec.check_deadlock),
         ),
-        check=check,
+        check=lambda: (check(), None),
         init_count=lambda: 1,
         properties=props,
         check_leads_to=leads_to,
@@ -540,13 +542,62 @@ def _gen_coverage_lines(spec, g):
 def _run_check_struct(args, spec) -> int:
     """Check a structural-frontend spec (E1): the full-module path that
     runs specs outside the gen subset - the reference's own KubeAPI.tla
-    included.  Device engine for safety, host graph for liveness, host
-    re-run for traces; same log protocol and exit conventions."""
+    included.  The LaneCompiler step is a first-class engine kernel now:
+    struct runs ride the production engines - segmented + supervised by
+    default (auto-regrow, checkpoints, SIGTERM drain), mesh-sharded
+    with -sharded - with the persistent step-compile cache warm-starting
+    repeated runs.  Host graph for liveness, host re-run for traces;
+    same log protocol and exit conventions."""
     from .struct import oracle as so
-    from .struct.engine import check_struct
+    from .struct.backend import struct_meta_config
+    from .struct.cache import get_backend
+    from .struct.engine import check_struct, check_struct_sharded
 
     sm = spec.structmodel
     system = sm.system
+    if args.recover and not args.checkpoint:
+        print("Error: -recover requires -checkpoint PATH", file=sys.stderr)
+        return 1
+    log_holder = []
+
+    def check():
+        log = log_holder[0]
+        ckd = spec.check_deadlock
+        kw = dict(chunk=args.chunk, queue_capacity=args.qcap,
+                  fp_capacity=args.fpcap)
+        if args.sharded:
+            import numpy as np
+            import jax
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(jax.devices()[: args.sharded]), ("fp",))
+            if args.checkpoint or args.autogrow:
+                from .resil import check_sharded_supervised
+
+                sup = check_sharded_supervised(
+                    None, mesh, backend=get_backend(sm, ckd),
+                    meta_config=struct_meta_config(sm),
+                    route_factor=args.routefactor,
+                    opts=_sup_opts(args, log), **kw,
+                )
+                return sup.result, sup
+            return check_struct_sharded(
+                sm, mesh, route_factor=args.routefactor,
+                check_deadlock=ckd, **kw,
+            ), None
+        if args.checkpoint or args.autogrow:
+            from .resil import check_supervised
+
+            sup = check_supervised(
+                None, fp_index=spec.fp_index,
+                backend=get_backend(sm, ckd),
+                meta_config=struct_meta_config(sm), check_deadlock=ckd,
+                opts=_sup_opts(args, log), **kw,
+            )
+            return sup.result, sup
+        return check_struct(
+            sm, fp_index=spec.fp_index, check_deadlock=ckd, **kw,
+        ), None
 
     def props():
         for name in spec.properties:
@@ -558,26 +609,20 @@ def _run_check_struct(args, spec) -> int:
                 continue
             yield name, ast[1], ast[2], None
 
+    def action_order():
+        # MC.out prints actions in module-definition order; lane labels
+        # ARE definition names, so def_order is the rendering order
+        names = set(get_backend(sm, spec.check_deadlock).labels)
+        ordered = [n for n in sm.module.def_order if n in names]
+        return ordered + [n for n in sorted(names) if n not in ordered]
+
     kit = _InterpKit(
         kind="structural",
-        # the structural liveness graph is wf_next-only so far; the
-        # mesh/checkpoint engines take the gen-kernel seam, which the
-        # struct compiler does not feed yet
+        # the structural liveness graph is wf_next-only so far
         extra_unsupported=(
             ("-fairness wf_process", args.fairness == "wf_process"),
-            ("-sharded", args.sharded),
-            ("-checkpoint", args.checkpoint),
-            ("-recover", args.recover),
-            ("-coverage", args.coverage),
         ),
-        check=lambda: check_struct(
-            sm,
-            chunk=args.chunk,
-            queue_capacity=args.qcap,
-            fp_capacity=args.fpcap,
-            fp_index=spec.fp_index,
-            check_deadlock=spec.check_deadlock,
-        ),
+        check=check,
         # lazy: Init enumeration is real work on struct specs and must
         # not run when the flags are about to be rejected
         init_count=lambda: len(system.initial_states()),
@@ -591,8 +636,9 @@ def _run_check_struct(args, spec) -> int:
         violation_trace=lambda: so.violation_trace(
             system, sm.invariants, check_deadlock=spec.check_deadlock
         ),
+        action_order=action_order,
     )
-    return _run_check_interp(args, spec, kit)
+    return _run_check_interp(args, spec, kit, log_holder=log_holder)
 
 
 class _InterpKit:
@@ -602,10 +648,10 @@ class _InterpKit:
     def __init__(self, kind, extra_unsupported, check, init_count,
                  properties, check_leads_to, fairness_label,
                  state_to_tla, state_env, violation_trace,
-                 coverage=None):
+                 coverage=None, action_order=None):
         self.kind = kind
         self.extra_unsupported = extra_unsupported
-        self.check = check
+        self.check = check  # () -> (CheckResult, SupervisedResult | None)
         self.init_count = init_count
         self.properties = properties
         self.check_leads_to = check_leads_to
@@ -614,9 +660,11 @@ class _InterpKit:
         self.state_env = state_env
         self.violation_trace = violation_trace
         self.coverage = coverage  # () -> dump lines, or None
+        self.action_order = action_order  # () -> coverage line order
 
 
-def _run_check_interp(args, spec, kit: "_InterpKit") -> int:
+def _run_check_interp(args, spec, kit: "_InterpKit",
+                      log_holder: list = None) -> int:
     """Shared runner for the interpreted frontends (gen + struct): the
     KubeAPI-engine knobs are rejected, the device engine checks safety,
     the host graph checks liveness, and violations re-run on the host
@@ -636,6 +684,8 @@ def _run_check_interp(args, spec, kit: "_InterpKit") -> int:
         )
         return 1
     log = TLCLog(tool_mode=not args.noTool)
+    if log_holder is not None:
+        log_holder.append(log)
     import jax
 
     device = str(jax.devices()[0])
@@ -645,9 +695,26 @@ def _run_check_interp(args, spec, kit: "_InterpKit") -> int:
     log.starting()
     log.computing_init()
     t0 = time.time()
-    r = kit.check()
+    from .resil import SlotOverflowError
+
+    try:
+        r, sup = kit.check()
+    except SlotOverflowError as e:
+        log.msg(1000, f"Run stopped: {e}", severity=1)
+        return 1
+    except FileNotFoundError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
     n_init = kit.init_count()
     log.init_done(n_init)
+    if sup is not None and sup.interrupted:
+        # the interrupted banner (with the resume command) was emitted
+        # by the supervisor's event hook
+        from .resil import EXIT_INTERRUPTED
+
+        log.progress(r.depth, r.generated, r.distinct, r.queue_left)
+        log.final_counts(r.generated, r.distinct, r.queue_left)
+        return EXIT_INTERRUPTED
     violated = r.violation != 0
     liveness_violated = False
     if not violated and spec.properties:
@@ -724,7 +791,9 @@ def _run_check_interp(args, spec, kit: "_InterpKit") -> int:
                     )
                 log.msg(2217, head + "\n" + text, severity=1)
     elif not liveness_violated:
-        log.success(r.generated, r.distinct, None)
+        log.success(r.generated, r.distinct,
+                    getattr(r, "actual_fp_collision", None),
+                    occupancy=getattr(r, "fp_occupancy", None))
         if args.coverage and kit.coverage is not None:
             # full per-expression dump: host re-walk with instrumented
             # evaluation, the KubeAPI path's discipline applied to the
@@ -732,8 +801,15 @@ def _run_check_interp(args, spec, kit: "_InterpKit") -> int:
             # coverage mode)
             log.coverage_gen_dump(kit.coverage())
         else:
+            act_gen, act_dist = r.action_generated, r.action_distinct
+            if kit.action_order is not None:
+                # per-action lines in module-definition (MC.out) order,
+                # zero-fire actions printed 0:0 exactly as TLC does
+                order = kit.action_order()
+                act_gen = {a: act_gen.get(a, 0) for a in order}
+                act_dist = {a: act_dist.get(a, 0) for a in order}
             log.coverage_generic(spec.spec_name, n_init,
-                                 r.action_generated, r.action_distinct)
+                                 act_gen, act_dist)
     log.progress(r.depth, r.generated, r.distinct, r.queue_left)
     log.final_counts(r.generated, r.distinct, r.queue_left)
     log.depth(r.depth)
@@ -840,6 +916,17 @@ def main(argv=None) -> int:
                         "supervisor (e.g. 'transient@1,sigterm@3,"
                         "write_fail@2,truncate@1'; tools/chaos.py drives "
                         "this end-to-end)")
+    c.add_argument("-compile-cache", dest="compilecache", default="",
+                   metavar="DIR",
+                   help="persistent XLA compile-cache directory for "
+                        "compiled steps (default ~/.cache/jaxtlc/xla, or "
+                        "$JAXTLC_COMPILE_CACHE; warm-starts repeated runs "
+                        "of the same model - delete the directory to "
+                        "clear it)")
+    c.add_argument("-no-compile-cache", dest="nocompilecache",
+                   action="store_true",
+                   help="disable the persistent compile cache for this "
+                        "run")
     c.add_argument("-coverage", action="store_true",
                    help="emit the full per-expression coverage dump "
                         "(TLC coverage mode; re-walks the space host-side)")
@@ -878,6 +965,10 @@ def main(argv=None) -> int:
                         "violation detection + trace reconstruction")
     args = p.parse_args(argv)
     _select_platform(args.workers)
+    if args.nocompilecache:
+        os.environ["JAXTLC_COMPILE_CACHE"] = "off"
+    elif args.compilecache:
+        os.environ["JAXTLC_COMPILE_CACHE"] = args.compilecache
     if args.cmd == "check":
         return _run_check(args)
     return 1

@@ -1,0 +1,141 @@
+"""Spec backends: the frontend -> engine seam.
+
+Everything an exhaustive engine (the fused single-device loop in
+engine.bfs, the mesh-sharded loop in engine.sharded, the fused
+enumerator) needs from a spec frontend, packaged as one NamedTuple so
+the hand-tuned KubeAPI kernel, the generic compiled lanes, and the
+structural lane compiler all plug into the same production machinery -
+TLC's engine working on any spec (launch:4-7) made literal.
+
+Optional capabilities degrade gracefully:
+
+* `gen_counts` - a factorized per-action generated-counter hook (the
+  KubeAPI kernel counts through its dispatch structure instead of
+  scatter-adds over all candidates, PERF.md item 5).  Backends without
+  one fall back to `lane_action` folding or a per-candidate reduce.
+* `lane_action` - a static lane -> action-id map for frontends whose
+  lane dispatch is static (gen + struct compilers emit one lane per
+  action binding); lets the engine fold per-action counters with a
+  [L, n_actions] compare-reduce instead of touching all chunk*L
+  candidates.
+* `check_deadlock` - TLC's -deadlock switch; backends for specs with
+  intended terminal states turn it off.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..spec.codec import get_codec
+from ..spec.invariants import make_invariant_kernel
+from ..spec.kernel import initial_vectors, lane_layout, make_kernel
+from ..spec.labels import LABEL_ID, LABELS
+from .bfs import VIOL_ONLYONEVERSION, VIOL_TYPEOK
+
+
+class SpecBackend(NamedTuple):
+    """Everything the production engines need from a spec frontend - the
+    hand-tuned KubeAPI pieces, the generic compiled lanes and the
+    structural lane compiler plug in through the same seam, so
+    distribution, segmented execution and the resil supervisor are
+    spec-agnostic (TLC's distributed mode works on any spec;
+    launch:4-7)."""
+
+    cdc: object  # pack/unpack/n_fields/nbits
+    step: object  # [F] -> (succ [L,F], valid, action, afail, ovf)
+    n_lanes: int
+    inv_check: object  # [F] -> ok_bits int32 (bit k = invariant k holds)
+    inv_codes: tuple  # bit k failing reports this violation code
+    initial_vectors: object  # () -> [n0, F] numpy
+    labels: tuple  # action id -> display name
+    viol_names: dict  # code -> name overrides (VIOLATION_NAMES fallback)
+    # optional capabilities (defaults preserve pre-seam constructors)
+    gen_counts: object = None  # fn(batch, valid) -> [n_labels] uint32
+    lane_action: object = None  # static [L] int32 lane -> action id
+    check_deadlock: bool = True  # TLC -deadlock switch
+
+
+def kubeapi_backend(cfg: ModelConfig) -> SpecBackend:
+    cdc = get_codec(cfg)
+    step = make_kernel(cfg)
+    CL, _ = lane_layout(cfg)
+    nc = cdc.nc
+    n_labels = len(LABELS)
+    pc_off = cdc.offsets["pc"]
+    label_ids = jnp.arange(n_labels, dtype=jnp.int32)
+    APISTART_ID = LABEL_ID["APIStart"]
+
+    def gen_counts(batch, valid):
+        # per-action generated counters, factorized through the dispatch
+        # structure: every lane of client ci fires that client's current
+        # pc label; server lanes are always APIStart (PERF.md item 5 -
+        # no scatter-adds over all chunk*L candidates)
+        counts = jnp.zeros(n_labels, jnp.uint32)
+        for ci in range(nc):
+            vc = valid[:, ci * CL : (ci + 1) * CL].sum(axis=1)
+            pcs = batch[:, pc_off + ci]
+            counts = counts + (
+                (pcs[:, None] == label_ids[None, :]) * vc[:, None]
+            ).sum(axis=0).astype(jnp.uint32)
+        return counts.at[APISTART_ID].add(
+            valid[:, nc * CL :].sum().astype(jnp.uint32)
+        )
+
+    return SpecBackend(
+        cdc=cdc,
+        step=step,
+        n_lanes=step.n_lanes,
+        inv_check=make_invariant_kernel(cfg),
+        inv_codes=(VIOL_TYPEOK, VIOL_ONLYONEVERSION),
+        initial_vectors=lambda: initial_vectors(cfg),
+        labels=LABELS,
+        viol_names={},
+        gen_counts=gen_counts,
+    )
+
+
+def gen_backend(spec) -> SpecBackend:
+    """Generic-frontend backend: the compiled lane kernel + codec feed
+    the same engines (VERDICT r4 item 4: -sharded for gen specs)."""
+    from ..gen.codec import GenCodec
+    from ..gen.engine import VIOL_INVARIANT_BASE
+    from ..gen.kernel import initial_field_vectors, make_gen_kernel
+
+    cdc = GenCodec(spec)
+    ker = make_gen_kernel(spec, cdc)
+    lane_action = jnp.asarray(ker.lane_action, jnp.int32)
+
+    def step(vec):
+        succs, valid, ovf = ker.step(vec)
+        afail = jnp.zeros_like(valid)  # the gen subset has no Assert
+        return succs, valid, lane_action, afail, ovf
+
+    def inv_check(vec):
+        bits = jnp.int32(0)
+        for k, (_, fn) in enumerate(ker.invariants):
+            bits = bits | (fn(vec).astype(jnp.int32) << k)
+        return bits
+
+    inv_names = list(spec.invariants.keys())
+    return SpecBackend(
+        cdc=cdc,
+        step=step,
+        n_lanes=ker.n_lanes,
+        inv_check=inv_check,
+        inv_codes=tuple(
+            VIOL_INVARIANT_BASE + k for k in range(len(inv_names))
+        ),
+        initial_vectors=lambda: np.asarray(
+            initial_field_vectors(spec, cdc)
+        ),
+        labels=tuple(a.name for a in spec.actions),
+        viol_names={
+            VIOL_INVARIANT_BASE + k: f"Invariant {n} is violated"
+            for k, n in enumerate(inv_names)
+        },
+        lane_action=lane_action,
+    )

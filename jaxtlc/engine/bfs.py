@@ -49,10 +49,7 @@ import numpy as np
 from jax import lax
 
 from ..config import ModelConfig
-from ..spec.codec import get_codec
-from ..spec.invariants import make_invariant_kernel
-from ..spec.kernel import initial_vectors, lane_layout, make_kernel
-from ..spec.labels import LABEL_ID, LABELS
+from ..spec.labels import LABELS
 from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED, fp64_words_mxu
 from .fpset import fpset_insert_sorted, fpset_new
 
@@ -145,7 +142,30 @@ def make_engine(
     seed: int = DEFAULT_SEED,
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
 ):
-    """Build (init_fn, run_fn, step_fn) for one configuration.
+    """Build (init_fn, run_fn, step_fn) for one KubeAPI configuration.
+
+    The hand-tuned KubeAPI path of make_backend_engine: the factorized
+    per-action counters and the rest of the v4 loop now come through the
+    SpecBackend seam, so this is a specialization, not a privilege."""
+    from .backend import kubeapi_backend
+
+    return make_backend_engine(
+        kubeapi_backend(cfg), chunk, queue_capacity, fp_capacity,
+        fp_index, seed, fp_highwater=fp_highwater,
+    )
+
+
+def make_backend_engine(
+    backend,
+    chunk: int = 1024,
+    queue_capacity: int = 1 << 15,
+    fp_capacity: int = 1 << 20,
+    fp_index: int = DEFAULT_FP_INDEX,
+    seed: int = DEFAULT_SEED,
+    fp_highwater: float = DEFAULT_FP_HIGHWATER,
+    check_deadlock: bool = None,
+):
+    """Build (init_fn, run_fn, step_fn) over any SpecBackend.
 
     init_fn() -> EngineCarry seeded with the Init states.
     run_fn(carry) -> EngineCarry after exhaustion/violation (jitted, fused).
@@ -159,31 +179,35 @@ def make_engine(
     halts with VIOL_FPSET_FULL instead of degrading into long straggler
     walks (open addressing past ~0.85 load is where probe cost blows up);
     the supervisor's auto-regrow doubles fp_capacity at this trigger.
+
+    check_deadlock overrides the backend's default (TLC's -deadlock
+    switch; None takes backend.check_deadlock).
     """
     assert 0.0 < fp_highwater <= 1.0, "fp_highwater must be in (0, 1]"
-    cdc = get_codec(cfg)
+    cdc = backend.cdc
     F = cdc.n_fields
     W = (cdc.nbits + 31) // 32
-    step = make_kernel(cfg)
-    L = step.n_lanes
-    CL, _ = lane_layout(cfg)
-    nc = cdc.nc
-    inv_check = make_invariant_kernel(cfg)
-    n_labels = len(LABELS)
+    step = backend.step
+    L = backend.n_lanes
+    inv_check = backend.inv_check
+    inv_codes = backend.inv_codes
+    n_labels = len(backend.labels)
     nbits = cdc.nbits
     qcap = queue_capacity
+    if check_deadlock is None:
+        check_deadlock = backend.check_deadlock
     # two-tier adaptive stepping: a step's cost is dominated by fixed
     # chunk-sized work regardless of how few states it pops, so narrow
     # levels (the BFS ramp/tail) and level remainders run a small body
     # instead of paying a full big-chunk step
     small = chunk // 16 if chunk >= 1 << 14 else 0
 
-    pc_off = cdc.offsets["pc"]
     label_ids = jnp.arange(n_labels, dtype=jnp.int32)
-    APISTART_ID = LABEL_ID["APIStart"]
+    lane_action = backend.lane_action
+    gen_counts_fn = backend.gen_counts
 
     def init_fn() -> EngineCarry:
-        inits = jnp.asarray(initial_vectors(cfg))
+        inits = jnp.asarray(backend.initial_vectors())
         n0 = inits.shape[0]
         assert n0 <= chunk and n0 <= qcap, "raise chunk/queue_capacity"
         packed0 = cdc.pack(inits)
@@ -197,6 +221,16 @@ def make_engine(
             fpset_new(fp_capacity), lo, hi, jnp.ones(n0, bool)
         )
         distinct0 = is_new_c.sum().astype(jnp.uint32)
+        # invariants hold on the initial states too (TLC checks them
+        # before the first Next application)
+        inv0 = jax.vmap(inv_check)(inits)
+        viol = jnp.int32(OK)
+        viol_state = jnp.zeros(F, jnp.int32)
+        for k, code in enumerate(inv_codes):
+            bad = (inv0 & (1 << k)) == 0
+            hit = bad.any() & (viol == OK)
+            viol = jnp.where(hit, code, viol)
+            viol_state = jnp.where(hit, inits[jnp.argmax(bad)], viol_state)
         return EngineCarry(
             fps=fps,
             queue=queue,
@@ -211,8 +245,8 @@ def make_engine(
             act_gen=jnp.zeros(n_labels + 1, jnp.uint32),
             act_dist=jnp.zeros(n_labels + 1, jnp.uint32),
             outdeg_hist=jnp.zeros(L + 2, jnp.uint32),
-            viol=jnp.int32(OK),
-            viol_state=jnp.zeros(F, jnp.int32),
+            viol=viol,
+            viol_state=viol_state,
             viol_action=jnp.int32(-1),
         )
 
@@ -244,15 +278,20 @@ def make_engine(
         valid = valid & mask[:, None]
         afail = afail & valid
         ovf = ovf & valid
-        dead = mask & ~valid.any(axis=1)
+        dead = (
+            mask & ~valid.any(axis=1) if check_deadlock
+            else jnp.zeros(chunk, bool)
+        )
 
         flat = succs.reshape(ncand, F)
         fvalid = valid.reshape(-1)
         faction = action.reshape(-1)
 
         inv = jax.vmap(inv_check)(flat)
-        bad_type = fvalid & ((inv & 1) == 0)
-        bad_oov = fvalid & ((inv & 2) == 0)
+        inv_bad = [
+            fvalid & ((inv & (1 << k)) == 0)
+            for k in range(len(inv_codes))
+        ]
 
         packed = cdc.pack(flat)
         lo, hi = fp64_words_mxu(packed, nbits, fp_index, seed)
@@ -356,21 +395,25 @@ def make_engine(
             (n - nruns).astype(jnp.uint32)
         )
 
-        # per-action generated counters, factorized through the dispatch
-        # structure: every lane of client ci fires that client's current pc
-        # label; server lanes are always APIStart
-        act_gen = c.act_gen
-        gen_counts = jnp.zeros(n_labels, jnp.uint32)
-        for ci in range(nc):
-            vc = valid[:, ci * CL : (ci + 1) * CL].sum(axis=1)
-            pcs = batch[:, pc_off + ci]
-            gen_counts = gen_counts + (
-                (pcs[:, None] == label_ids[None, :]) * vc[:, None]
+        # per-action generated counters, scatter-free: the backend's
+        # factorized hook (KubeAPI dispatch structure, PERF.md item 5)
+        # when it has one, a [L, n_labels] fold for static lane
+        # dispatches (gen/struct compilers), a per-candidate
+        # compare-reduce otherwise
+        if gen_counts_fn is not None:
+            gen_counts = gen_counts_fn(batch, valid)
+        elif lane_action is not None:
+            lane_counts = valid.sum(axis=0).astype(jnp.uint32)
+            gen_counts = (
+                (lane_action[:, None] == label_ids[None, :])
+                * lane_counts[:, None]
             ).sum(axis=0).astype(jnp.uint32)
-        gen_counts = gen_counts.at[APISTART_ID].add(
-            valid[:, nc * CL :].sum().astype(jnp.uint32)
-        )
-        act_gen = act_gen.at[:n_labels].add(gen_counts)
+        else:
+            gen_counts = (
+                (faction[:, None] == label_ids[None, :])
+                & fvalid[:, None]
+            ).sum(axis=0).astype(jnp.uint32)
+        act_gen = c.act_gen.at[:n_labels].add(gen_counts)
 
         generated = c.generated + valid.sum().astype(jnp.uint32)
         distinct = c.distinct + n_new.astype(jnp.uint32)
@@ -387,8 +430,8 @@ def make_engine(
         viol_action = c.viol_action
 
         for code, vmask, states, acts in (
-            (VIOL_TYPEOK, bad_type, flat, faction),
-            (VIOL_ONLYONEVERSION, bad_oov, flat, faction),
+            *((code, bad, flat, faction)
+              for code, bad in zip(inv_codes, inv_bad)),
             (VIOL_ASSERT, afail.reshape(-1), jnp.repeat(batch, L, axis=0), faction),
             (VIOL_DEADLOCK, dead, batch, jnp.full(chunk, -1, jnp.int32)),
             (VIOL_SLOT_OVERFLOW, ovf.reshape(-1), jnp.repeat(batch, L, axis=0), faction),
@@ -660,30 +703,34 @@ def outdegree_from_hist(hist: np.ndarray):
 
 def result_from_carry(
     carry: EngineCarry, wall_s: float, iterations: int = -1,
-    fp_capacity: int = 0,
+    fp_capacity: int = 0, labels: tuple = LABELS, viol_names: dict = None,
 ) -> CheckResult:
     """Pull a finished (or interrupted) carry to host as a CheckResult."""
-    act_gen = np.asarray(carry.act_gen)[: len(LABELS)]
-    act_dist = np.asarray(carry.act_dist)[: len(LABELS)]
+    act_gen = np.asarray(carry.act_gen)[: len(labels)]
+    act_dist = np.asarray(carry.act_dist)[: len(labels)]
     hist = np.asarray(carry.outdeg_hist)[:-1].astype(np.int64)  # drop dump
     outdegree = outdegree_from_hist(hist)
     occupancy = (
         int(carry.distinct) / fp_capacity if fp_capacity else None
+    )
+    viol = int(carry.viol)
+    vname = (viol_names or {}).get(viol) or VIOLATION_NAMES.get(
+        viol, f"violation {viol}"
     )
     return CheckResult(
         generated=int(carry.generated),
         distinct=int(carry.distinct),
         depth=int(carry.depth),
         queue_left=int(carry.level_n) - int(carry.qhead) + int(carry.next_n),
-        violation=int(carry.viol),
-        violation_name=VIOLATION_NAMES[int(carry.viol)],
+        violation=viol,
+        violation_name=vname,
         violation_state=np.asarray(carry.viol_state),
         violation_action=int(carry.viol_action),
         action_generated={
-            LABELS[i]: int(v) for i, v in enumerate(act_gen) if v
+            labels[i]: int(v) for i, v in enumerate(act_gen) if v
         },
         action_distinct={
-            LABELS[i]: int(v) for i, v in enumerate(act_dist) if v
+            labels[i]: int(v) for i, v in enumerate(act_dist) if v
         },
         wall_s=wall_s,
         iterations=iterations,

@@ -168,7 +168,13 @@ class _SignalCatcher:
 class SingleDeviceAdapter:
     """Supervision seam over the single-device segmented engine
     (engine.checkpoint's driver, reshaped so the supervisor owns the
-    loop).  Growable params: queue_capacity, fp_capacity."""
+    loop).  Growable params: queue_capacity, fp_capacity.
+
+    `backend` (a SpecBackend) swaps the hand-tuned KubeAPI kernel for
+    any frontend's compiled step - struct-compiled specs ride the SAME
+    supervision loop, checkpoint format and regrow migration with zero
+    frontend-specific recovery code; `meta_config` then replaces the
+    ModelConfig stanza in the checkpoint meta."""
 
     kind = "single"
     GEOM_KEYS = ("queue_capacity", "fp_capacity")
@@ -177,19 +183,34 @@ class SingleDeviceAdapter:
 
     def __init__(self, cfg, chunk: int = 1024,
                  fp_index: int = DEFAULT_FP_INDEX, seed: int = DEFAULT_SEED,
-                 fp_highwater: float = DEFAULT_FP_HIGHWATER):
+                 fp_highwater: float = DEFAULT_FP_HIGHWATER,
+                 backend=None, meta_config: dict = None,
+                 check_deadlock: bool = True):
         self.cfg = cfg
         self.chunk = chunk
         self.fp_index = fp_index
         self.seed = seed
         self.fp_highwater = fp_highwater
+        self.backend = backend
+        self.meta_config = meta_config
+        self.check_deadlock = check_deadlock
 
     def build(self, params: dict, ckpt_every: int):
-        init_fn, _, step_fn = make_engine(
-            self.cfg, self.chunk, params["queue_capacity"],
-            params["fp_capacity"], self.fp_index, self.seed,
-            fp_highwater=self.fp_highwater,
-        )
+        if self.backend is not None:
+            from ..engine.bfs import make_backend_engine
+
+            init_fn, _, step_fn = make_backend_engine(
+                self.backend, self.chunk, params["queue_capacity"],
+                params["fp_capacity"], self.fp_index, self.seed,
+                fp_highwater=self.fp_highwater,
+                check_deadlock=self.check_deadlock,
+            )
+        else:
+            init_fn, _, step_fn = make_engine(
+                self.cfg, self.chunk, params["queue_capacity"],
+                params["fp_capacity"], self.fp_index, self.seed,
+                fp_highwater=self.fp_highwater,
+            )
 
         @jax.jit
         def segment(c):
@@ -201,8 +222,9 @@ class SingleDeviceAdapter:
 
     def meta(self, params: dict) -> dict:
         return ckpt._meta(
-            self.cfg, chunk=self.chunk, fp_index=self.fp_index,
-            seed=self.seed, fp_highwater=self.fp_highwater, **params,
+            self.cfg, meta_config=self.meta_config, chunk=self.chunk,
+            fp_index=self.fp_index, seed=self.seed,
+            fp_highwater=self.fp_highwater, **params,
         )
 
     def viol(self, carry) -> int:
@@ -225,9 +247,13 @@ class SingleDeviceAdapter:
         from ..engine.fpset import fpset_actual_collision
 
         afc = float(fpset_actual_collision(carry.fps))
+        kw = {}
+        if self.backend is not None:
+            kw = dict(labels=self.backend.labels,
+                      viol_names=self.backend.viol_names)
         return result_from_carry(
             carry, wall, iterations=segments,
-            fp_capacity=params["fp_capacity"],
+            fp_capacity=params["fp_capacity"], **kw,
         )._replace(actual_fp_collision=afc)
 
 
@@ -542,13 +568,19 @@ def check_supervised(
     fp_index: int = DEFAULT_FP_INDEX,
     seed: int = DEFAULT_SEED,
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
+    backend=None,
+    meta_config: dict = None,
+    check_deadlock: bool = True,
     opts: SupervisorOptions = None,
 ) -> SupervisedResult:
     """Supervised single-device exhaustive check (the check_with_
-    checkpoints signature, plus self-healing)."""
+    checkpoints signature, plus self-healing).  `backend`/`meta_config`
+    run any SpecBackend (struct-compiled specs included) through the
+    same supervision loop; cfg is then ignored."""
     adapter = SingleDeviceAdapter(
         cfg, chunk=chunk, fp_index=fp_index, seed=seed,
-        fp_highwater=fp_highwater,
+        fp_highwater=fp_highwater, backend=backend,
+        meta_config=meta_config, check_deadlock=check_deadlock,
     )
     return supervise(
         adapter,

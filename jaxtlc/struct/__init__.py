@@ -12,4 +12,7 @@ executes the transition relation directly:
 * oracle:  BFS model checker over the interpreted relation
 * shapes:  finite-universe inference for device compilation
 * compile: AST -> lane kernel for the fused device engine
+* backend: the lane kernel as a SpecBackend for the production engines
+           (fused single-device, mesh-sharded, supervised/segmented)
+* cache:   in-process step-compile memo + persistent XLA compile cache
 """

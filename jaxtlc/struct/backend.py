@@ -1,0 +1,124 @@
+"""Struct-compiled specs as a SpecBackend (the engine seam).
+
+The LaneCompiler step (struct.compile) becomes a pluggable kernel for
+the production engines: the fused single-device loop
+(engine.bfs.make_backend_engine), the mesh-sharded loop
+(engine.sharded.make_sharded_engine) and the resil supervisor's
+segmented drivers all consume this backend, so struct specs get
+segmented execution, fingerprint-space mesh sharding, checkpoints,
+auto-regrow and two-tier adaptive stepping through the exact code paths
+the hand kernel uses - no private BFS loop (the round-6 tentpole; the
+old struct/engine.py loop is retired).
+
+The compiler emits a batch step ([B, L, F] directly); the engines
+expect a per-row kernel they vmap themselves, so the step here is a
+B=1 wrapper - under vmap the batch dimension is re-introduced by
+tracing, producing the same fused XLA as the native batch compile.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.backend import SpecBackend
+from ..engine.bfs import VIOL_ASSERT
+from .codec import StructCodec
+from .compile import LaneCompiler
+from .loader import StructModel
+from .shapes import infer_shapes, typeok_hints
+
+VIOL_INVARIANT_BASE = 100
+
+
+def struct_viol_names(model: StructModel) -> Dict[int, str]:
+    """Violation-code name overrides for a struct model (invariants by
+    cfg order + the PlusCal assertion channel)."""
+    names = {VIOL_ASSERT: "Failure of PlusCal assertion"}
+    for k, name in enumerate(model.invariants):
+        names[VIOL_INVARIANT_BASE + k] = f"Invariant {name} is violated"
+    return names
+
+
+def struct_backend(model: StructModel,
+                   check_deadlock: bool = True) -> SpecBackend:
+    """Compile `model` into a SpecBackend: parse -> shape-infer ->
+    lane-compile, the pipeline struct.cache memoizes in-process."""
+    system = model.system
+    hints = typeok_hints(system.ev, model.invariants, system.variables)
+    var_shapes = infer_shapes(system.ev, system.variables,
+                              system.init_ast, system.next_ast,
+                              hints=hints)
+    cdc = StructCodec(system.variables, var_shapes)
+    compiler = LaneCompiler(system.ev, system.variables, var_shapes, cdc)
+    batch_step = compiler.build_step(system.next_ast)
+    inv_fns = [
+        compiler.build_invariant(ast) for ast in model.invariants.values()
+    ]
+    F = cdc.n_fields
+
+    # discover the lane structure (labels) with a shape-only trace
+    jax.eval_shape(batch_step, jax.ShapeDtypeStruct((1, F), jnp.int32))
+    labels: List[str] = list(compiler.labels)
+    action_names: Tuple[str, ...] = tuple(sorted(set(labels)))
+    lane_action = jnp.asarray(
+        [action_names.index(x) for x in labels], jnp.int32
+    )
+
+    def step(vec):
+        succs, valid, ovf, afail = batch_step(vec[None])
+        return succs[0], valid[0], lane_action, afail[0], ovf[0]
+
+    def inv_check(vec):
+        bits = jnp.int32(0)
+        for k, fn in enumerate(inv_fns):
+            bits = bits | (fn(vec[None])[0].astype(jnp.int32) << k)
+        return bits
+
+    def initial_vectors():
+        inits = system.initial_states()
+        return np.stack([cdc.encode(st) for st in inits])
+
+    return SpecBackend(
+        cdc=cdc,
+        step=step,
+        n_lanes=len(labels),
+        inv_check=inv_check,
+        inv_codes=tuple(
+            VIOL_INVARIANT_BASE + k for k in range(len(model.invariants))
+        ),
+        initial_vectors=initial_vectors,
+        labels=action_names,
+        viol_names=struct_viol_names(model),
+        lane_action=lane_action,
+        check_deadlock=check_deadlock,
+    )
+
+
+def canonical_constants(model: StructModel) -> dict:
+    """JSON-stable rendering of the model's resolved constants (the
+    checkpoint-meta / cache-key form; frozensets sort, everything else
+    goes through repr so model values and numbers stay distinct)."""
+    out = {}
+    for k in sorted(model.constants):
+        v = model.constants[k]
+        out[k] = (sorted(map(repr, v)) if isinstance(v, frozenset)
+                  else repr(v))
+    return out
+
+
+def struct_meta_config(model: StructModel) -> dict:
+    """The checkpoint `config` stanza for struct runs: digest +
+    canonical constants + invariant list - everything that shapes the
+    compiled step, so a -recover against a different spec text or
+    overrides is a loud mismatch, never a silent misrun."""
+    return {
+        "frontend": "struct",
+        "root": model.root_name,
+        "digest": model.source_digest,
+        "constants": canonical_constants(model),
+        "invariants": list(model.invariants),
+    }

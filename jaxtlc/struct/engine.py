@@ -1,288 +1,45 @@
-"""Device BFS engine for struct-compiled specs (E1).
+"""Device checking of struct-compiled specs (E1) on the production
+engines.
 
-The same fused v4 design as the generic engine (gen/engine.py): ping-
-pong packed level buffers, sort-compacted dedup against the bucketized
-fingerprint table, contiguous enqueue, MXU fingerprints - fed by the
-lane kernel that struct.compile derives from the module text.  Adds an
-assertion-failure channel (PlusCal `assert`, KubeAPI.tla:196,216,348 -
-the hand kernel has the same channel; the gen subset has no Assert).
+The private struct BFS loop is retired (round-6 tentpole): the
+LaneCompiler step plugs into the same fused v4 engine the hand kernel
+uses (engine.bfs.make_backend_engine via struct.backend.struct_backend),
+so struct specs get the bucketized sort-compacted dedup, MXU
+fingerprints, contiguous enqueue, two-tier adaptive stepping, segmented
+execution (the resil supervisor's unit of work), TLC outdegree stats
+and the assertion-failure channel from one code path.  Mesh sharding
+routes through engine.sharded with the same backend.
 
-The step is batch-compiled (the compiler emits [B, L, F] directly), so
-no vmap wrapper is needed.
+Engine builds are memoized and XLA compiles persist across processes
+(struct.cache): repeated runs of the same model skip the minutes-long
+compile (bench.py --struct tracks the warm-start win).
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, NamedTuple
 
 import jax
-import jax.numpy as jnp
-import numpy as np
-from jax import lax
 
 from ..engine.bfs import (
-    OK,
-    VIOL_ASSERT,
-    VIOL_DEADLOCK,
-    VIOL_FPSET_FULL,
-    VIOL_QUEUE_FULL,
-    VIOL_SLOT_OVERFLOW,
-    VIOLATION_NAMES,
     CheckResult,
+    VIOLATION_NAMES,
+    result_from_carry,
 )
-from ..engine.fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED, fp64_words_mxu
-from ..engine.fpset import fpset_insert_sorted, fpset_new
-from .codec import StructCodec
-from .compile import LaneCompiler
+from ..engine.fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
+from .backend import (  # noqa: F401 - VIOL_INVARIANT_BASE is API here
+    VIOL_INVARIANT_BASE,
+    struct_backend,
+    struct_viol_names,
+)
+from .cache import get_backend, get_engine
 from .loader import StructModel
-from .shapes import infer_shapes
-
-VIOL_INVARIANT_BASE = 100
-
-
-class StructCarry(NamedTuple):
-    fps: tuple
-    queue: jnp.ndarray
-    parity: jnp.ndarray
-    qhead: jnp.ndarray
-    level_n: jnp.ndarray
-    next_n: jnp.ndarray
-    level: jnp.ndarray
-    depth: jnp.ndarray
-    generated: jnp.ndarray
-    distinct: jnp.ndarray
-    act_gen: jnp.ndarray
-    act_dist: jnp.ndarray
-    viol: jnp.ndarray
-    viol_state: jnp.ndarray
-
-
-def make_struct_engine(
-    model: StructModel,
-    chunk: int = 1024,
-    queue_capacity: int = 1 << 15,
-    fp_capacity: int = 1 << 20,
-    fp_index: int = DEFAULT_FP_INDEX,
-    seed: int = DEFAULT_SEED,
-    check_deadlock: bool = True,
-):
-    system = model.system
-    from .shapes import typeok_hints
-
-    hints = typeok_hints(system.ev, model.invariants, system.variables)
-    var_shapes = infer_shapes(system.ev, system.variables,
-                              system.init_ast, system.next_ast,
-                              hints=hints)
-    cdc = StructCodec(system.variables, var_shapes)
-    compiler = LaneCompiler(system.ev, system.variables, var_shapes, cdc)
-    step = compiler.build_step(system.next_ast)
-    inv_fns = [
-        (name, compiler.build_invariant(ast))
-        for name, ast in model.invariants.items()
-    ]
-    F = cdc.n_fields
-    W = cdc.n_words
-    qcap = queue_capacity
-
-    # discover lane structure (labels) with a tiny eager run
-    inits = system.initial_states()
-    init_fields = np.stack([cdc.encode(st) for st in inits])
-    _ = jax.eval_shape(step, jax.ShapeDtypeStruct((1, F), jnp.int32))
-    labels = compiler.labels
-    L = len(labels)
-    action_names = sorted(set(labels))
-    n_actions = len(action_names)
-    lane_action = jnp.asarray(
-        [action_names.index(x) for x in labels], jnp.int32
-    )
-
-    def init_fn() -> StructCarry:
-        inits_j = jnp.asarray(init_fields, jnp.int32)
-        n0 = inits_j.shape[0]
-        assert n0 <= chunk and n0 <= qcap
-        packed0 = cdc.pack(inits_j)
-        queue = (
-            jnp.zeros((2, qcap + 2 * chunk, W), jnp.uint32)
-            .at[0, :n0]
-            .set(packed0)
-        )
-        lo, hi = fp64_words_mxu(packed0, cdc.nbits, fp_index, seed)
-        fps, is_new_c, _, _ = fpset_insert_sorted(
-            fpset_new(fp_capacity), lo, hi, jnp.ones(n0, bool)
-        )
-        viol = jnp.int32(OK)
-        viol_state = jnp.zeros(F, jnp.int32)
-        for k, (_, fn) in enumerate(inv_fns):
-            bad = ~fn(inits_j)
-            hit = bad.any() & (viol == OK)
-            viol = jnp.where(hit, VIOL_INVARIANT_BASE + k, viol)
-            viol_state = jnp.where(hit, inits_j[jnp.argmax(bad)],
-                                   viol_state)
-        return StructCarry(
-            fps=fps,
-            queue=queue,
-            parity=jnp.int32(0),
-            qhead=jnp.int32(0),
-            level_n=jnp.int32(n0),
-            next_n=jnp.int32(0),
-            level=jnp.int32(1),
-            depth=jnp.int32(1),
-            generated=jnp.uint32(n0),
-            distinct=is_new_c.sum().astype(jnp.uint32),
-            act_gen=jnp.zeros(n_actions, jnp.uint32),
-            act_dist=jnp.zeros(n_actions, jnp.uint32),
-            viol=viol,
-            viol_state=viol_state,
-        )
-
-    ncand = chunk * L
-    R = min(2 * chunk, ncand)
-    A = min(2 * chunk, ncand)
-
-    def body(c: StructCarry) -> StructCarry:
-        avail = c.level_n - c.qhead
-        n = jnp.minimum(chunk, avail)
-        rows = jnp.arange(chunk, dtype=jnp.int32)
-        mask = rows < n
-
-        block = lax.dynamic_slice(
-            c.queue, (c.parity, c.qhead, jnp.int32(0)), (1, chunk, W)
-        )[0]
-        batch = cdc.unpack(block)
-
-        succs, valid, ovf, afail = step(batch)
-        valid = valid & mask[:, None]
-        ovf = ovf & mask[:, None]
-        afail = afail & mask[:, None]
-        dead = mask & ~valid.any(axis=1) if check_deadlock else (
-            jnp.zeros(chunk, bool)
-        )
-
-        flat = succs.reshape(ncand, F)
-        fvalid = valid.reshape(-1)
-
-        viol = c.viol
-        viol_state = c.viol_state
-        for k, (_, fn) in enumerate(inv_fns):
-            bad = fvalid & ~fn(flat)
-            hit = bad.any() & (viol == OK)
-            viol = jnp.where(hit, VIOL_INVARIANT_BASE + k, viol)
-            viol_state = jnp.where(hit, flat[jnp.argmax(bad)], viol_state)
-
-        packed = cdc.pack(flat)
-        lo, hi = fp64_words_mxu(packed, cdc.nbits, fp_index, seed)
-
-        fp_full = (c.distinct.astype(jnp.int32) + ncand) > int(
-            fp_capacity * 0.85
-        )
-        insert_mask = fvalid & ~fp_full
-        fps, is_new_c, c_idx, _ = fpset_insert_sorted(
-            c.fps, lo, hi, insert_mask, probe_width=R, claim_width=R
-        )
-        n_new = is_new_c.sum().astype(jnp.int32)
-        q_full = c.next_n + n_new > qcap
-
-        _, e_idx = lax.sort(
-            ((~is_new_c).astype(jnp.uint32), c_idx.astype(jnp.uint32)),
-            num_keys=2,
-            is_stable=True,
-        )
-        e_idx_p = jnp.concatenate([e_idx, jnp.zeros(A, jnp.uint32)])
-
-        def enq_cond(st):
-            _, s = st
-            return s * A < n_new
-
-        def enq_body(st):
-            queue, s = st
-            offs = s * A
-            idx_a = lax.dynamic_slice(e_idx_p, (offs,), (A,)).astype(
-                jnp.int32
-            )
-            rows_a = packed[idx_a]
-            woff = jnp.minimum(c.next_n + offs, qcap)
-            queue = lax.dynamic_update_slice(
-                queue, rows_a[None], (1 - c.parity, woff, jnp.int32(0))
-            )
-            return queue, s + 1
-
-        queue, _ = lax.while_loop(enq_cond, enq_body,
-                                  (c.queue, jnp.int32(0)))
-
-        lane_onehot = (
-            lane_action[:, None] == jnp.arange(n_actions)[None, :]
-        )
-        lane_counts = valid.sum(axis=0).astype(jnp.uint32)
-        act_gen = c.act_gen + (
-            lane_onehot * lane_counts[:, None]
-        ).sum(axis=0).astype(jnp.uint32)
-
-        new_act = jnp.where(
-            jnp.arange(ncand) < n_new,
-            lane_action[e_idx.astype(jnp.int32) % L],
-            -1,
-        )
-        act_dist = c.act_dist + (
-            new_act[:, None] == jnp.arange(n_actions)[None, :]
-        ).sum(axis=0).astype(jnp.uint32)
-
-        generated = c.generated + valid.sum().astype(jnp.uint32)
-        distinct = c.distinct + n_new.astype(jnp.uint32)
-
-        for code, vmask, states in (
-            (VIOL_ASSERT, afail.any(axis=1), batch),
-            (VIOL_SLOT_OVERFLOW, ovf.any(axis=1), batch),
-            (VIOL_DEADLOCK, dead, batch),
-        ):
-            hit = vmask.any() & (viol == OK)
-            viol = jnp.where(hit, code, viol)
-            viol_state = jnp.where(
-                hit, states[jnp.argmax(vmask)], viol_state
-            )
-        hit = fp_full & fvalid.any() & (viol == OK)
-        viol = jnp.where(hit, VIOL_FPSET_FULL, viol)
-        hit = q_full & (viol == OK)
-        viol = jnp.where(hit, VIOL_QUEUE_FULL, viol)
-
-        qhead = c.qhead + n
-        next_n = jnp.minimum(c.next_n + n_new, qcap)
-        level_done = qhead >= c.level_n
-        advance = level_done & (next_n > 0)
-        parity = jnp.where(level_done, 1 - c.parity, c.parity)
-        level_n = jnp.where(level_done, next_n, c.level_n)
-        next_n = jnp.where(level_done, 0, next_n)
-        qhead = jnp.where(level_done, 0, qhead)
-        level = jnp.where(advance, c.level + 1, c.level)
-        depth = jnp.maximum(c.depth, level)
-
-        return StructCarry(
-            fps=fps, queue=queue, parity=parity, qhead=qhead,
-            level_n=level_n, next_n=next_n, level=level, depth=depth,
-            generated=generated, distinct=distinct, act_gen=act_gen,
-            act_dist=act_dist, viol=viol, viol_state=viol_state,
-        )
-
-    def cond(c: StructCarry):
-        return ((c.qhead < c.level_n) | (c.next_n > 0)) & (c.viol == OK)
-
-    @jax.jit
-    def run_fn(c: StructCarry) -> StructCarry:
-        return lax.while_loop(cond, body, c)
-
-    return init_fn, run_fn, cdc, action_names
 
 
 def violation_name(model: StructModel, code: int) -> str:
-    if code >= VIOL_INVARIANT_BASE:
-        names = list(model.invariants.keys())
-        k = code - VIOL_INVARIANT_BASE
-        if k < len(names):
-            return f"Invariant {names[k]} is violated"
-        return "Invariant violated"
-    if code == VIOL_ASSERT:
-        return "Failure of PlusCal assertion"
-    return VIOLATION_NAMES[code]
+    return struct_viol_names(model).get(code) or VIOLATION_NAMES.get(
+        code, f"violation {code}"
+    )
 
 
 def check_struct(
@@ -293,35 +50,43 @@ def check_struct(
     fp_index: int = DEFAULT_FP_INDEX,
     seed: int = DEFAULT_SEED,
     check_deadlock: bool = True,
+    fp_highwater: float = 0.85,
 ) -> CheckResult:
-    """Exhaustive device check of a struct-compiled spec."""
-    init_fn, run_fn, cdc, action_names = make_struct_engine(
+    """Exhaustive device check of a struct-compiled spec (single device,
+    fused loop; AOT-compiled before timing like bfs.check)."""
+    init_fn, run_fn, _ = get_engine(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
-        check_deadlock,
+        fp_highwater, check_deadlock=check_deadlock,
     )
+    backend = get_backend(model, check_deadlock)
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()
     t0 = time.time()
     out = jax.block_until_ready(compiled(carry))
     wall = time.time() - t0
-    code = int(out.viol)
-    act_gen = np.asarray(out.act_gen)
-    act_dist = np.asarray(out.act_dist)
-    return CheckResult(
-        generated=int(out.generated),
-        distinct=int(out.distinct),
-        depth=int(out.depth),
-        queue_left=int(out.level_n) - int(out.qhead) + int(out.next_n),
-        violation=code,
-        violation_name=violation_name(model, code),
-        violation_state=np.asarray(out.viol_state),
-        violation_action=-1,
-        action_generated={
-            action_names[i]: int(v) for i, v in enumerate(act_gen) if v
-        },
-        action_distinct={
-            action_names[i]: int(v) for i, v in enumerate(act_dist) if v
-        },
-        wall_s=wall,
-        iterations=-1,
+    return result_from_carry(
+        out, wall, fp_capacity=fp_capacity, labels=backend.labels,
+        viol_names=struct_viol_names(model),
+    )
+
+
+def check_struct_sharded(
+    model: StructModel,
+    mesh,
+    chunk: int = 512,
+    queue_capacity: int = 1 << 14,
+    fp_capacity: int = 1 << 18,
+    route_factor: float = 2.0,
+    check_deadlock: bool = True,
+) -> CheckResult:
+    """Exhaustive mesh-sharded check of a struct-compiled spec
+    (capacities PER DEVICE; fingerprint-space all_to_all partitioning,
+    psum-reduced counters - engine.sharded, same backend seam)."""
+    from ..engine.sharded import check_sharded
+
+    backend = get_backend(model, check_deadlock)
+    return check_sharded(
+        None, mesh, chunk=chunk, queue_capacity=queue_capacity,
+        fp_capacity=fp_capacity, route_factor=route_factor,
+        backend=backend,
     )

@@ -11,6 +11,7 @@ Sequences, TLC) are built into the evaluator.
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Dict, NamedTuple, Optional
 
@@ -34,6 +35,11 @@ class StructModel(NamedTuple):
     module: Module
     fairness: Optional[str]  # "wf_next" | None
     root_name: str
+    # sha256 over every source text this model was loaded from (cfg +
+    # module closure) plus the constant overrides - the step-compile
+    # cache key component that changes iff the spec's meaning can
+    # (struct.cache keys its memo and the checkpoint meta on it)
+    source_digest: str = ""
 
 
 class StructLoadError(ValueError):
@@ -72,11 +78,15 @@ def _parse_const_literal(text: str):
     return t
 
 
-def _load_module_closure(path: str, search_dirs) -> Module:
+def _load_module_closure(path: str, search_dirs, texts=None) -> Module:
     """Parse `path` and fold in its non-builtin EXTENDS (depth-first,
-    extended defs first so the extender can override)."""
+    extended defs first so the extender can override).  `texts`, when
+    given, collects every (path, source) read - the digest input."""
     with open(path) as f:
-        root = parse_module(f.read())
+        src = f.read()
+    if texts is not None:
+        texts.append((path, src))
+    root = parse_module(src)
     defs: Dict[str, Definition] = {}
     def_order = []
     variables = []
@@ -107,7 +117,7 @@ def _load_module_closure(path: str, search_dirs) -> Module:
             raise StructLoadError(
                 f"EXTENDS {ext}: no {ext}.tla in {list(search_dirs)}"
             )
-        fold(_load_module_closure(found, search_dirs))
+        fold(_load_module_closure(found, search_dirs, texts))
     fold(root)
     return Module(
         name=root.name,
@@ -125,10 +135,11 @@ def load(cfg_path: str,
     model_dir = os.path.dirname(os.path.abspath(cfg_path))
     toolbox_parent = os.path.dirname(os.path.dirname(model_dir))
     search_dirs = (model_dir, toolbox_parent)
+    texts = [(cfg_path, open(cfg_path).read())]
 
     mc_path = os.path.join(model_dir, "MC.tla")
     if os.path.exists(mc_path):
-        module = _load_module_closure(mc_path, search_dirs)
+        module = _load_module_closure(mc_path, search_dirs, texts)
         root_name = next(
             (e for e in module.extends if e not in _BUILTIN_MODULES), "MC"
         )
@@ -144,8 +155,16 @@ def load(cfg_path: str,
                     f"no MC.tla and no {base}.tla next to {cfg_path}"
                 )
             cand = os.path.join(model_dir, tlas[0])
-        module = _load_module_closure(cand, search_dirs)
+        module = _load_module_closure(cand, search_dirs, texts)
         root_name = module.name
+
+    digest = hashlib.sha256()
+    for _, src in texts:
+        digest.update(src.encode())
+        digest.update(b"\x00")
+    if const_overrides:
+        for k in sorted(const_overrides):
+            digest.update(f"{k}={const_overrides[k]!r};".encode())
 
     constants: Dict[str, object] = {}
     for name, val in cfg.constants.items():
@@ -196,4 +215,5 @@ def load(cfg_path: str,
         module=module,
         fairness=fairness,
         root_name=root_name,
+        source_digest=digest.hexdigest(),
     )

@@ -25,3 +25,29 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: full-state-space runs (minutes on 1 CPU core)"
     )
+
+
+# -- collection errors are fatal, never silently-green (ISSUE 3) -----------
+#
+# Tier-1 runs with --continue-on-collection-errors so one broken module
+# doesn't hide every other module's results, but an ImportError must
+# still sink the run LOUDLY: a module that fails to collect contributes
+# zero failing tests, and a green-looking run with a quietly-skipped
+# module shipped a never-executed exit-criterion test once already
+# (test_struct_engine's package-relative import).  Collect every failed
+# collection report and abort the session after collection finishes.
+
+_COLLECT_ERRORS = []
+
+
+def pytest_collectreport(report):
+    if report.failed:
+        _COLLECT_ERRORS.append(str(report.nodeid or report.fspath))
+
+
+def pytest_collection_finish(session):
+    if _COLLECT_ERRORS:
+        raise pytest.UsageError(
+            "test collection failed (a broken import must never ship as "
+            "silently-skipped green): " + ", ".join(_COLLECT_ERRORS)
+        )

@@ -1,0 +1,40 @@
+"""Shared MC.out expectation constants for the Model_1 parity tests.
+
+tests/ is NOT a package (no __init__.py), so test modules must import
+each other as top-level modules (`import mc_expect`), never with
+package-relative syntax - `from .test_struct import ...` raised
+ImportError at run time and silently benched the device-parity test
+(ISSUE 3 satellite).  Keeping the constants in a non-test module also
+spares importers the cost of collecting another test file's fixtures.
+"""
+
+REF_CFG = "/root/reference/KubeAPI.toolbox/Model_1/MC.cfg"
+
+# MC.out final statistics (MC.out:1098,1101)
+MC_OUT_COUNTS = (577736, 163408, 124)
+
+# MC.out per-action totals, action -> (distinct, generated) (MC.out:78-621)
+MC_OUT_ACTIONS = {
+    "DoRequest": (19655, 149766),
+    "DoReply": (21141, 67334),
+    "DoListRequest": (10094, 82416),
+    "DoListReply": (11718, 70584),
+    "CStart": (16702, 54342),
+    "C1": (8396, 13373),
+    "C10": (4495, 6257),
+    "C11": (5337, 8877),
+    "c12": (1566, 2620),
+    "C13": (6556, 12302),
+    "C2": (364, 770),
+    "C3": (854, 1346),
+    "C8": (463, 673),
+    "C6": (317, 426),
+    "C7": (502, 708),
+    "C4": (307, 483),
+    "C5": (857, 1253),
+    "PVCStart": (14398, 25217),
+    "PVCListedPVCs": (13306, 33946),
+    "PVCHavePVCs": (6460, 13459),
+    "PVCDone": (1766, 4523),
+    "APIStart": (18152, 27059),
+}

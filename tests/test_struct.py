@@ -20,33 +20,9 @@ from jaxtlc.struct.loader import load
 from jaxtlc.struct.oracle import bfs, violation_trace
 from jaxtlc.struct.parser import parse_expression, parse_module
 
-REF_CFG = "/root/reference/KubeAPI.toolbox/Model_1/MC.cfg"
-
-# MC.out per-action totals, action -> (distinct, generated) (MC.out:78-621)
-MC_OUT_ACTIONS = {
-    "DoRequest": (19655, 149766),
-    "DoReply": (21141, 67334),
-    "DoListRequest": (10094, 82416),
-    "DoListReply": (11718, 70584),
-    "CStart": (16702, 54342),
-    "C1": (8396, 13373),
-    "C10": (4495, 6257),
-    "C11": (5337, 8877),
-    "c12": (1566, 2620),
-    "C13": (6556, 12302),
-    "C2": (364, 770),
-    "C3": (854, 1346),
-    "C8": (463, 673),
-    "C6": (317, 426),
-    "C7": (502, 708),
-    "C4": (307, 483),
-    "C5": (857, 1253),
-    "PVCStart": (14398, 25217),
-    "PVCListedPVCs": (13306, 33946),
-    "PVCHavePVCs": (6460, 13459),
-    "PVCDone": (1766, 4523),
-    "APIStart": (18152, 27059),
-}
+# tests/ is not a package: shared expectation constants live in the
+# plain module mc_expect (importable as top-level from any test module)
+from mc_expect import MC_OUT_ACTIONS, REF_CFG  # noqa: F401
 
 
 def _load(fail: bool, timeout: bool):
