@@ -29,6 +29,10 @@ Usage:
     python bench.py --struct   # struct-compiled workload: cold + warm
                                # (persistent compile cache) runs; emits
                                # distinct_states_per_s + struct_warm_start_s
+    python bench.py --pipeline-ab  # Model_1 with -pipeline and
+                               # -no-pipeline in one invocation: both
+                               # rates + a step_overlap_ms metric line,
+                               # full-signature bit-equality gated
 """
 
 import json
@@ -47,12 +51,17 @@ EXPECT = {
 
 
 def _emit(payload: dict) -> None:
-    """The contract: exactly one JSON line on stdout, on EVERY exit path."""
+    """The contract: exactly one JSON line on stdout, on EVERY exit path.
+
+    Every payload records the engine pipeline setting (ISSUE 4: the A/B
+    harness and history need to know which step schedule produced a
+    number); modes that run both put their setting in explicitly."""
     base = {
         "metric": "distinct_states_per_s",
         "value": 0,
         "unit": "states/s",
         "vs_baseline": 0,
+        "pipeline": False,
     }
     base.update(payload)
     print(json.dumps(base), flush=True)
@@ -335,9 +344,99 @@ def bench_struct(probe_err: str) -> int:
     return 0
 
 
+def bench_pipeline_ab(probe_err: str) -> int:
+    """--pipeline-ab: A/B the pipelined step schedule against the fused
+    one, in one invocation.
+
+    Runs Model_1 (the TLC-comparable workload) twice through the AOT
+    engine - `-no-pipeline` then `-pipeline` at the same chunk, where
+    the pipelined run is contractually BIT-FOR-BIT identical (full
+    signature gate below, not just counts) - and emits a
+    `step_overlap_ms` line (per-level wall saved by overlap; negative
+    means the pipeline lost) plus the rate line carrying both rates.
+    Best-of-2 walls per mode damp timer noise."""
+    device_note = ""
+    if probe_err:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        device_note = f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
+    import jax
+
+    from jaxtlc.config import MODEL_1
+    from jaxtlc.engine.bfs import check
+
+    workload = "Model_1"
+    kw = dict(chunk=1024, queue_capacity=1 << 15, fp_capacity=1 << 20)
+    runs = {}
+    for pipelined in (False, True):
+        best = None
+        for _ in range(2):
+            r = check(MODEL_1, pipeline=pipelined, **kw)
+            if r.violation or (
+                r.generated, r.distinct, r.depth
+            ) != EXPECT[workload]:
+                _emit({"error": f"pipeline={pipelined} count mismatch: "
+                                f"{(r.generated, r.distinct, r.depth)}",
+                       "workload": workload, "pipeline": pipelined})
+                return 1
+            if best is None or r.wall_s < best.wall_s:
+                best = r
+        runs[pipelined] = best
+
+    def signature(r):
+        return (r.generated, r.distinct, r.depth, r.violation,
+                tuple(sorted(r.action_generated.items())),
+                tuple(sorted(r.action_distinct.items())),
+                r.outdegree, r.fp_occupancy)
+
+    if signature(runs[False]) != signature(runs[True]):
+        _emit({"error": "pipelined run is not bit-identical to the "
+                        "unpipelined engine", "workload": workload})
+        return 1
+
+    wall_np, wall_p = runs[False].wall_s, runs[True].wall_s
+    depth = runs[False].depth
+    overlap_ms = 1000.0 * (wall_np - wall_p) / depth
+    device = str(jax.devices()[0]) + device_note
+    _emit(
+        {
+            "metric": "step_overlap_ms",
+            "value": round(overlap_ms, 3),
+            "unit": "ms/level-step",
+            "workload": workload,
+            "wall_s_no_pipeline": round(wall_np, 3),
+            "wall_s_pipeline": round(wall_p, 3),
+            "levels": depth,
+            "pipeline": True,
+            "device": device,
+        }
+    )
+    rate_p = runs[True].distinct / wall_p
+    rate_np = runs[False].distinct / wall_np
+    _emit(
+        {
+            "value": round(rate_p, 1),
+            "vs_baseline": round(rate_p / TLC_DISTINCT_PER_S, 2),
+            "workload": workload,
+            "rate_pipeline": round(rate_p, 1),
+            "rate_no_pipeline": round(rate_np, 1),
+            "generated": runs[True].generated,
+            "distinct": runs[True].distinct,
+            "depth": runs[True].depth,
+            "wall_s": round(wall_p, 3),
+            "pipeline": True,
+            "device": device,
+        }
+    )
+    return 0
+
+
 def main() -> int:
     device_note = ""
     probe_err = _probe_backend()
+    if "--pipeline-ab" in sys.argv:
+        return bench_pipeline_ab(probe_err)
     if "--liveness" in sys.argv:
         return bench_liveness(probe_err)
     if "--resil" in sys.argv:

@@ -243,6 +243,7 @@ def _dispatch_check(args, spec, log):
                 queue_capacity=args.qcap,
                 fp_capacity=args.fpcap,
                 route_factor=args.routefactor,
+                pipeline=args.pipeline,
                 opts=_sup_opts(args, log),
             )
             return sup.result, sup
@@ -253,6 +254,7 @@ def _dispatch_check(args, spec, log):
             queue_capacity=args.qcap,
             fp_capacity=args.fpcap,
             route_factor=args.routefactor,
+            pipeline=args.pipeline,
         ), None
     if args.fpset == "DiskFPSet":
         # the OffHeapDiskFPSet/DiskStateQueue analog: authoritative dedup +
@@ -286,6 +288,7 @@ def _dispatch_check(args, spec, log):
             queue_capacity=args.qcap,
             fp_capacity=args.fpcap,
             fp_index=spec.fp_index,
+            pipeline=args.pipeline,
             opts=_sup_opts(args, log),
         )
         return sup.result, sup
@@ -297,6 +300,7 @@ def _dispatch_check(args, spec, log):
         queue_capacity=args.qcap,
         fp_capacity=args.fpcap,
         fp_index=spec.fp_index,
+        pipeline=args.pipeline,
     ), None
 
 
@@ -365,6 +369,8 @@ def _resume_command(args) -> str:
         parts += ["-chunk", str(args.chunk)]
     if args.sharded:
         parts += ["-sharded", str(args.sharded)]
+    if args.pipeline:
+        parts += ["-pipeline"]  # checkpoints only resume in the same mode
     if args.frontend != "auto":
         parts += ["-frontend", args.frontend]
     if not args.checkpoint:
@@ -465,6 +471,7 @@ def _run_check_gen(args, spec) -> int:
             fp_capacity=args.fpcap,
             route_factor=args.routefactor,
             backend=backend,
+            pipeline=args.pipeline,
         )
         if args.checkpoint:
             meta_config = {
@@ -578,12 +585,13 @@ def _run_check_struct(args, spec) -> int:
                     None, mesh, backend=get_backend(sm, ckd),
                     meta_config=struct_meta_config(sm),
                     route_factor=args.routefactor,
+                    pipeline=args.pipeline,
                     opts=_sup_opts(args, log), **kw,
                 )
                 return sup.result, sup
             return check_struct_sharded(
                 sm, mesh, route_factor=args.routefactor,
-                check_deadlock=ckd, **kw,
+                check_deadlock=ckd, pipeline=args.pipeline, **kw,
             ), None
         if args.checkpoint or args.autogrow:
             from .resil import check_supervised
@@ -592,11 +600,13 @@ def _run_check_struct(args, spec) -> int:
                 None, fp_index=spec.fp_index,
                 backend=get_backend(sm, ckd),
                 meta_config=struct_meta_config(sm), check_deadlock=ckd,
+                pipeline=args.pipeline,
                 opts=_sup_opts(args, log), **kw,
             )
             return sup.result, sup
         return check_struct(
-            sm, fp_index=spec.fp_index, check_deadlock=ckd, **kw,
+            sm, fp_index=spec.fp_index, check_deadlock=ckd,
+            pipeline=args.pipeline, **kw,
         ), None
 
     def props():
@@ -878,6 +888,19 @@ def main(argv=None) -> int:
     c.add_argument("-sharded", type=int, default=0, metavar="N",
                    help="run the sharded engine over N devices")
     c.add_argument("-chunk", type=int, default=1024)
+    c.add_argument("-pipeline", dest="pipeline", action="store_true",
+                   default=False,
+                   help="software-pipeline the device engines: commit "
+                        "(dedup/enqueue) of block k-1 overlaps expansion "
+                        "of block k, with the sharded verdict-return "
+                        "all_to_all deferred behind the next routing "
+                        "collective.  Bit-for-bit identical counts; for "
+                        "maximum overlap run with HALF the unpipelined "
+                        "sweet-spot -chunk (PERF.md round 7).  A "
+                        "checkpoint records this setting: -recover "
+                        "must use the same mode")
+    c.add_argument("-no-pipeline", dest="pipeline", action="store_false",
+                   help="(default) the fused single-stage step bodies")
     c.add_argument("-routefactor", type=float, default=2.0,
                    help="sharded all_to_all bucket size as a multiple of "
                         "the mean per-owner candidate count (raise after "

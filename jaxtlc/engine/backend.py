@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -34,7 +35,15 @@ from ..spec.codec import get_codec
 from ..spec.invariants import make_invariant_kernel
 from ..spec.kernel import initial_vectors, lane_layout, make_kernel
 from ..spec.labels import LABEL_ID, LABELS
-from .bfs import VIOL_ONLYONEVERSION, VIOL_TYPEOK
+from .bfs import (
+    OK,
+    VIOL_ASSERT,
+    VIOL_DEADLOCK,
+    VIOL_ONLYONEVERSION,
+    VIOL_SLOT_OVERFLOW,
+    VIOL_TYPEOK,
+)
+from .fingerprint import fp64_words_mxu
 
 
 class SpecBackend(NamedTuple):
@@ -57,6 +66,135 @@ class SpecBackend(NamedTuple):
     gen_counts: object = None  # fn(batch, valid) -> [n_labels] uint32
     lane_action: object = None  # static [L] int32 lane -> action id
     check_deadlock: bool = True  # TLC -deadlock switch
+    # optional expand-stage override: fn with make_expand_stage's
+    # signature, for backends that can fuse their own expansion half of
+    # the pipelined step (the commit half - dedup/enqueue/counters - is
+    # engine-owned and backend-independent)
+    expand: object = None
+
+
+class ExpandOut(NamedTuple):
+    """Output of the expand stage of one engine step: everything the
+    commit stage (sort-compact dedup -> fpset probe/claim -> enqueue +
+    counters) needs from a popped block, with the per-candidate kernel /
+    invariant work already reduced.  This is the unit the pipelined
+    engine stages in its carry so block k's expansion can overlap block
+    k-1's commit (PERF.md round 7)."""
+
+    packed: jnp.ndarray  # [chunk*L, W] uint32 packed candidate states
+    lo: jnp.ndarray  # [chunk*L] uint32 fingerprint low words
+    hi: jnp.ndarray  # [chunk*L] uint32 fingerprint high words
+    valid: jnp.ndarray  # [chunk*L] bool
+    action: jnp.ndarray  # [chunk*L] int32
+    gen: jnp.ndarray  # [n_labels] uint32 per-action generated counts
+    viol: jnp.ndarray  # int32 first-wins expand-stage violation code
+    viol_state: jnp.ndarray  # [F] int32
+    viol_action: jnp.ndarray  # int32
+
+
+def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
+                      fp_index: int, seed: int):
+    """Build the expand half of an engine step over `backend`'s seam:
+    unpack -> vmapped successor kernel -> invariants -> pack ->
+    MXU fingerprints -> per-action generated counters -> first-wins
+    expand-stage violation (invariant > assert > deadlock > slot).
+
+    Returns expand(batch [chunk, F] int32, mask [chunk] bool) ->
+    ExpandOut.  Both the fused (unpipelined) body and the pipelined
+    body call this one function, so the split cannot drift; a backend
+    may override it wholesale via SpecBackend.expand."""
+    if backend.expand is not None:
+        return backend.expand(backend, chunk, check_deadlock,
+                              fp_index, seed)
+    cdc = backend.cdc
+    F = cdc.n_fields
+    step = backend.step
+    L = backend.n_lanes
+    inv_check = backend.inv_check
+    inv_codes = backend.inv_codes
+    n_labels = len(backend.labels)
+    nbits = cdc.nbits
+    ncand = chunk * L
+    label_ids = jnp.arange(n_labels, dtype=jnp.int32)
+    lane_action = backend.lane_action
+    gen_counts_fn = backend.gen_counts
+    if check_deadlock is None:
+        check_deadlock = backend.check_deadlock
+
+    def expand(batch, mask):
+        succs, valid, action, afail, ovf = jax.vmap(step)(batch)
+        valid = valid & mask[:, None]
+        afail = afail & valid
+        ovf = ovf & valid
+        dead = (
+            mask & ~valid.any(axis=1) if check_deadlock
+            else jnp.zeros(chunk, bool)
+        )
+
+        flat = succs.reshape(ncand, F)
+        fvalid = valid.reshape(-1)
+        faction = action.reshape(-1)
+
+        inv = jax.vmap(inv_check)(flat)
+        inv_bad = [
+            fvalid & ((inv & (1 << k)) == 0)
+            for k in range(len(inv_codes))
+        ]
+
+        packed = cdc.pack(flat)
+        lo, hi = fp64_words_mxu(packed, nbits, fp_index, seed)
+
+        # per-action generated counters, scatter-free: the backend's
+        # factorized hook (KubeAPI dispatch structure, PERF.md item 5)
+        # when it has one, a [L, n_labels] fold for static lane
+        # dispatches (gen/struct compilers), a per-candidate
+        # compare-reduce otherwise
+        if gen_counts_fn is not None:
+            gen = gen_counts_fn(batch, valid)
+        elif lane_action is not None:
+            lane_counts = valid.sum(axis=0).astype(jnp.uint32)
+            gen = (
+                (lane_action[:, None] == label_ids[None, :])
+                * lane_counts[:, None]
+            ).sum(axis=0).astype(jnp.uint32)
+        else:
+            gen = (
+                (faction[:, None] == label_ids[None, :])
+                & fvalid[:, None]
+            ).sum(axis=0).astype(jnp.uint32)
+
+        # expand-stage violations, first wins (priority: invariant >
+        # assert > deadlock > slot overflow); capacity violations are
+        # commit-stage and merged after these by the engine
+        viol = jnp.int32(OK)
+        viol_state = jnp.zeros(F, jnp.int32)
+        viol_action = jnp.int32(-1)
+        for code, vmask, states, acts in (
+            *((code, bad, flat, faction)
+              for code, bad in zip(inv_codes, inv_bad)),
+            (VIOL_ASSERT, afail.reshape(-1),
+             jnp.repeat(batch, L, axis=0), faction),
+            (VIOL_DEADLOCK, dead, batch,
+             jnp.full(chunk, -1, jnp.int32)),
+            (VIOL_SLOT_OVERFLOW, ovf.reshape(-1),
+             jnp.repeat(batch, L, axis=0), faction),
+        ):
+            hit = vmask.any() & (viol == OK)
+            viol = jnp.where(hit, code, viol)
+            viol_state = jnp.where(
+                hit, states[jnp.argmax(vmask)], viol_state
+            )
+            viol_action = jnp.where(
+                hit, acts[jnp.argmax(vmask)].astype(jnp.int32),
+                viol_action,
+            )
+        return ExpandOut(
+            packed=packed, lo=lo, hi=hi, valid=fvalid, action=faction,
+            gen=gen, viol=viol, viol_state=viol_state,
+            viol_action=viol_action,
+        )
+
+    return expand
 
 
 def kubeapi_backend(cfg: ModelConfig) -> SpecBackend:

@@ -95,6 +95,24 @@ class EngineCarry(NamedTuple):
     viol: jnp.ndarray  # int32 code
     viol_state: jnp.ndarray  # [F] int32
     viol_action: jnp.ndarray  # int32
+    # --- pipelined-engine staged block (None on unpipelined engines) ---
+    # The expand-stage output (backend.ExpandOut) of the in-flight pop:
+    # popped and expanded but not yet committed - the next loop body
+    # commits it while expanding the following block, so XLA can overlap
+    # block k's kernel/fingerprint work with block k-1's sort/probe/
+    # enqueue row ops (PERF.md round 7).  None leaves vanish from the
+    # pytree, so unpipelined carries keep their exact pre-pipeline
+    # checkpoint layout.
+    st_packed: jnp.ndarray = None  # [chunk*L, W] uint32
+    st_lo: jnp.ndarray = None  # [chunk*L] uint32
+    st_hi: jnp.ndarray = None  # [chunk*L] uint32
+    st_valid: jnp.ndarray = None  # [chunk*L] bool
+    st_action: jnp.ndarray = None  # [chunk*L] int32
+    st_gen: jnp.ndarray = None  # [n_labels] uint32
+    st_n: jnp.ndarray = None  # int32: popped rows staged (0 = empty)
+    st_viol: jnp.ndarray = None  # int32 expand-stage violation code
+    st_viol_state: jnp.ndarray = None  # [F] int32
+    st_viol_action: jnp.ndarray = None  # int32
 
 
 class CheckResult(NamedTuple):
@@ -125,9 +143,14 @@ class CheckResult(NamedTuple):
 
 def carry_done(carry: EngineCarry) -> bool:
     """Host-side termination check (used by the checkpointed driver)."""
+    if int(carry.viol) != OK:
+        return True
+    pending = carry.st_n is not None and int(carry.st_n) > 0
     return (
-        int(carry.level_n) - int(carry.qhead) <= 0 and int(carry.next_n) == 0
-    ) or int(carry.viol) != OK
+        int(carry.level_n) - int(carry.qhead) <= 0
+        and int(carry.next_n) == 0
+        and not pending
+    )
 
 
 DEFAULT_FP_HIGHWATER = 0.85
@@ -141,6 +164,8 @@ def make_engine(
     fp_index: int = DEFAULT_FP_INDEX,
     seed: int = DEFAULT_SEED,
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
+    pipeline: bool = False,
+    donate: bool = True,
 ):
     """Build (init_fn, run_fn, step_fn) for one KubeAPI configuration.
 
@@ -151,7 +176,8 @@ def make_engine(
 
     return make_backend_engine(
         kubeapi_backend(cfg), chunk, queue_capacity, fp_capacity,
-        fp_index, seed, fp_highwater=fp_highwater,
+        fp_index, seed, fp_highwater=fp_highwater, pipeline=pipeline,
+        donate=donate,
     )
 
 
@@ -164,6 +190,8 @@ def make_backend_engine(
     seed: int = DEFAULT_SEED,
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
     check_deadlock: bool = None,
+    pipeline: bool = False,
+    donate: bool = True,
 ):
     """Build (init_fn, run_fn, step_fn) over any SpecBackend.
 
@@ -182,12 +210,36 @@ def make_backend_engine(
 
     check_deadlock overrides the backend's default (TLC's -deadlock
     switch; None takes backend.check_deadlock).
+
+    pipeline=True software-pipelines the step: the body is split into an
+    expand stage (unpack -> kernel -> invariants -> fingerprints) and a
+    commit stage (sort-compact dedup -> fpset probe/claim -> enqueue +
+    counters), and the carry stages block k's ExpandOut so body i
+    commits block k-1 WHILE expanding block k - two blocks in flight,
+    giving the XLA scheduler overlap freedom across the stages (SURVEY
+    §2.4 level pipelining; PERF.md round 7).  The pop sequence and every
+    arbitration decision are unchanged, so a pipelined run is bit-for-bit
+    identical to the unpipelined engine at the same chunk (full
+    signature: counts, depth, per-action, outdegree, fpset content) for
+    chunks below the two-tier threshold; at chunk >= 2^14 the pipelined
+    engine runs single-tier (full-width stages) where the unpipelined
+    engine would switch to small bodies, so exact counts still match but
+    in-batch attribution may not.  For overlap in the one-step-per-level
+    regime, run the pipelined engine at HALF the unpipelined sweet-spot
+    chunk so every level spans >= 2 blocks (PERF.md round 7 sizing).
+
+    donate=True (ignored on CPU, where XLA has no donation) marks the
+    carry argument of run_fn/step_fn donated so XLA aliases the ping-pong
+    queue/candidate buffers across iterations instead of copying.  Pass
+    donate=False when the SAME carry value is fed to the engine twice
+    (profilers, the resil supervisor's retry-from-last-good loop).
     """
+    from .backend import ExpandOut, make_expand_stage
+
     assert 0.0 < fp_highwater <= 1.0, "fp_highwater must be in (0, 1]"
     cdc = backend.cdc
     F = cdc.n_fields
     W = (cdc.nbits + 31) // 32
-    step = backend.step
     L = backend.n_lanes
     inv_check = backend.inv_check
     inv_codes = backend.inv_codes
@@ -199,12 +251,14 @@ def make_backend_engine(
     # two-tier adaptive stepping: a step's cost is dominated by fixed
     # chunk-sized work regardless of how few states it pops, so narrow
     # levels (the BFS ramp/tail) and level remainders run a small body
-    # instead of paying a full big-chunk step
-    small = chunk // 16 if chunk >= 1 << 14 else 0
+    # instead of paying a full big-chunk step.  The pipelined engine is
+    # single-tier: its staged-block carry has one static width, and
+    # mixing widths would change the pop sequence vs the bit-exactness
+    # contract above.
+    small = chunk // 16 if (chunk >= 1 << 14 and not pipeline) else 0
 
     label_ids = jnp.arange(n_labels, dtype=jnp.int32)
-    lane_action = backend.lane_action
-    gen_counts_fn = backend.gen_counts
+    ncand_full = chunk * L
 
     def init_fn() -> EngineCarry:
         inits = jnp.asarray(backend.initial_vectors())
@@ -231,6 +285,20 @@ def make_backend_engine(
             hit = bad.any() & (viol == OK)
             viol = jnp.where(hit, code, viol)
             viol_state = jnp.where(hit, inits[jnp.argmax(bad)], viol_state)
+        staged = {}
+        if pipeline:
+            staged = dict(
+                st_packed=jnp.zeros((ncand_full, W), jnp.uint32),
+                st_lo=jnp.zeros(ncand_full, jnp.uint32),
+                st_hi=jnp.zeros(ncand_full, jnp.uint32),
+                st_valid=jnp.zeros(ncand_full, bool),
+                st_action=jnp.zeros(ncand_full, jnp.int32),
+                st_gen=jnp.zeros(n_labels, jnp.uint32),
+                st_n=jnp.int32(0),
+                st_viol=jnp.int32(OK),
+                st_viol_state=jnp.zeros(F, jnp.int32),
+                st_viol_action=jnp.int32(-1),
+            )
         return EngineCarry(
             fps=fps,
             queue=queue,
@@ -248,10 +316,14 @@ def make_backend_engine(
             viol=viol,
             viol_state=viol_state,
             viol_action=jnp.int32(-1),
+            **staged,
         )
 
-    def make_body(ck: int):
-        """One BFS step popping up to `ck` states (carry shape-invariant)."""
+    def make_stages(ck: int):
+        """(pop_expand, commit) at pop width `ck` - the two halves of one
+        BFS step.  The unpipelined body runs them back to back; the
+        pipelined body runs commit on the PREVIOUS block's staged
+        ExpandOut while pop_expand works on the next block."""
         ncand = ck * L
         # compaction widths: probe/claim/enqueue touch only this many rows
         # per segment; steady-state new-per-chunk == chunk, so 2x covers
@@ -259,247 +331,278 @@ def make_backend_engine(
         R = min(2 * ck, ncand)  # fpset probe width
         CW = min(2 * ck, R)  # fpset round-0 claim width
         A = min(2 * ck, ncand)  # enqueue/stat segment width
-        return lambda c: step_body(c, ck, ncand, R, CW, A)
-
-    def step_body(c: EngineCarry, chunk: int, ncand: int, R: int, CW: int,
-                  A: int) -> EngineCarry:
-        avail = c.level_n - c.qhead
-        n = jnp.minimum(chunk, avail)
-        rows = jnp.arange(chunk, dtype=jnp.int32)
-        mask = rows < n
-
-        # contiguous pop (the buffer is chunk-padded so no OOB clamping)
-        block = lax.dynamic_slice(
-            c.queue, (c.parity, c.qhead, jnp.int32(0)), (1, chunk, W)
-        )[0]
-        batch = cdc.unpack(block)
-
-        succs, valid, action, afail, ovf = jax.vmap(step)(batch)
-        valid = valid & mask[:, None]
-        afail = afail & valid
-        ovf = ovf & valid
-        dead = (
-            mask & ~valid.any(axis=1) if check_deadlock
-            else jnp.zeros(chunk, bool)
+        expand_fn = make_expand_stage(
+            backend, ck, check_deadlock, fp_index, seed
         )
 
-        flat = succs.reshape(ncand, F)
-        fvalid = valid.reshape(-1)
-        faction = action.reshape(-1)
-
-        inv = jax.vmap(inv_check)(flat)
-        inv_bad = [
-            fvalid & ((inv & (1 << k)) == 0)
-            for k in range(len(inv_codes))
-        ]
-
-        packed = cdc.pack(flat)
-        lo, hi = fp64_words_mxu(packed, nbits, fp_index, seed)
-
-        fp_full = (c.distinct.astype(jnp.int32) + ncand) > int(
-            fp_capacity * fp_highwater
-        )
-        insert_mask = fvalid & ~fp_full
-        fps, is_new_c, c_idx, nreps = fpset_insert_sorted(
-            c.fps, lo, hi, insert_mask, probe_width=R, claim_width=CW
-        )
-        n_new = is_new_c.sum().astype(jnp.int32)
-        q_full = c.next_n + n_new > qcap
-
-        # enqueue + per-new-state stats: bring new entries to the front
-        # ordered by original lane index (2-key sort) - the same append
-        # order as the v3 scatter engine, so pop order and therefore
-        # in-batch attribution statistics (outdegree min/max, MC.out:1104)
-        # are preserved bit-for-bit.  All new entries sit in the first
-        # nreps compacted positions, so when nreps fits the probe width
-        # the sort runs at R width instead of ncand (~6x less comparator
-        # traffic); the full-width branch covers all-distinct bursts.
-        new_key = (~is_new_c).astype(jnp.uint32)
-        cidx_u = c_idx.astype(jnp.uint32)
-
-        def e_sorted_sliced(_):
-            _, e = lax.sort(
-                (new_key[:R], cidx_u[:R]), num_keys=2, is_stable=True
-            )
-            return jnp.concatenate([e, jnp.zeros(ncand - R, jnp.uint32)])
-
-        def e_sorted_full(_):
-            _, e = lax.sort((new_key, cidx_u), num_keys=2, is_stable=True)
-            return e
-
-        if R == ncand:
-            _, e_idx = lax.sort(
-                (new_key, cidx_u), num_keys=2, is_stable=True
-            )
-        else:
-            e_idx = lax.cond(
-                nreps <= R, e_sorted_sliced, e_sorted_full, 0
-            )
-        e_idx_p = jnp.concatenate([e_idx, jnp.zeros(A, jnp.uint32)])
-
-        def enq_cond(st):
-            _, _, s = st
-            return s * A < n_new
-
-        def enq_body(st):
-            queue, act_dist, s = st
-            offs = s * A
-            idx_a = lax.dynamic_slice(e_idx_p, (offs,), (A,)).astype(
-                jnp.int32
-            )
-            active = (jnp.arange(A) + offs) < n_new
-            rows_a = packed[idx_a]  # [A, W] row gather (the only one)
-            woff = jnp.minimum(c.next_n + offs, qcap)
-            queue = lax.dynamic_update_slice(
-                queue, rows_a[None], (1 - c.parity, woff, jnp.int32(0))
-            )
-            # per-action distinct counts by [A, n_labels] compare-reduce
-            # (scatter-adds cost ~140ns/element on-chip)
-            acts_a = faction[idx_a]
-            act_dist = act_dist.at[:n_labels].add(
-                (
-                    (acts_a[:, None] == label_ids[None, :])
-                    & active[:, None]
-                ).sum(axis=0).astype(jnp.uint32)
-            )
-            return queue, act_dist, s + 1
-
-        queue, act_dist, _ = lax.while_loop(
-            enq_cond, enq_body, (c.queue, c.act_dist, jnp.int32(0))
-        )
-
-        # outdegree histogram of the popped states (TLC's outdegree =
-        # distinct new successors per expansion, MC.out:1104) via run
-        # lengths: e_idx's active prefix is ascending in source row, so
-        # each row's new-child count is a run length - no [chunk+1]-bin
-        # scatter-add
-        pos = jnp.arange(ncand)
-        active_new = pos < n_new
-        src_e = jnp.where(active_new, e_idx.astype(jnp.int32) // L, -1)
-        startf = jnp.concatenate(
-            [jnp.ones(1, bool), src_e[1:] != src_e[:-1]]
-        ) & active_new
-        endf = jnp.concatenate(
-            [src_e[1:] != src_e[:-1], jnp.ones(1, bool)]
-        ) & active_new
-        run0 = lax.cummax(jnp.where(startf, pos, 0))
-        run_len = jnp.where(endf, pos - run0 + 1, 0)
-        nruns = startf.sum()
-        deg_hist = (
-            (run_len[:, None] == jnp.arange(1, L + 1)[None, :])
-            .sum(axis=0)
-            .astype(jnp.uint32)
-        )
-        outdeg_hist = c.outdeg_hist.at[1 : L + 1].add(deg_hist)
-        outdeg_hist = outdeg_hist.at[0].add(
-            (n - nruns).astype(jnp.uint32)
-        )
-
-        # per-action generated counters, scatter-free: the backend's
-        # factorized hook (KubeAPI dispatch structure, PERF.md item 5)
-        # when it has one, a [L, n_labels] fold for static lane
-        # dispatches (gen/struct compilers), a per-candidate
-        # compare-reduce otherwise
-        if gen_counts_fn is not None:
-            gen_counts = gen_counts_fn(batch, valid)
-        elif lane_action is not None:
-            lane_counts = valid.sum(axis=0).astype(jnp.uint32)
-            gen_counts = (
-                (lane_action[:, None] == label_ids[None, :])
-                * lane_counts[:, None]
-            ).sum(axis=0).astype(jnp.uint32)
-        else:
-            gen_counts = (
-                (faction[:, None] == label_ids[None, :])
-                & fvalid[:, None]
-            ).sum(axis=0).astype(jnp.uint32)
-        act_gen = c.act_gen.at[:n_labels].add(gen_counts)
-
-        generated = c.generated + valid.sum().astype(jnp.uint32)
-        distinct = c.distinct + n_new.astype(jnp.uint32)
-
-        # violations (first wins; priority: invariant > assert > deadlock >
-        # capacity).  Capture the offending state: candidate for invariants,
-        # source state for assert/deadlock.
-        def first_state(mask_flat, states):
-            i = jnp.argmax(mask_flat)
-            return states[i]
-
-        viol = c.viol
-        viol_state = c.viol_state
-        viol_action = c.viol_action
-
-        for code, vmask, states, acts in (
-            *((code, bad, flat, faction)
-              for code, bad in zip(inv_codes, inv_bad)),
-            (VIOL_ASSERT, afail.reshape(-1), jnp.repeat(batch, L, axis=0), faction),
-            (VIOL_DEADLOCK, dead, batch, jnp.full(chunk, -1, jnp.int32)),
-            (VIOL_SLOT_OVERFLOW, ovf.reshape(-1), jnp.repeat(batch, L, axis=0), faction),
-        ):
-            hit = vmask.any() & (viol == OK)
-            viol = jnp.where(hit, code, viol)
-            viol_state = jnp.where(hit, first_state(vmask, states), viol_state)
-            viol_action = jnp.where(
-                hit, acts[jnp.argmax(vmask)].astype(jnp.int32), viol_action
-            )
-        hit = fp_full & fvalid.any() & (viol == OK)
-        viol = jnp.where(hit, VIOL_FPSET_FULL, viol)
-        hit = q_full & (viol == OK)
-        viol = jnp.where(hit, VIOL_QUEUE_FULL, viol)
-
-        # level bookkeeping: ping-pong at the level boundary
-        qhead = c.qhead + n
-        next_n = jnp.minimum(c.next_n + n_new, qcap)
-        level_done = qhead >= c.level_n
-        advance = level_done & (next_n > 0)
-        parity = jnp.where(level_done, 1 - c.parity, c.parity)
-        level_n = jnp.where(level_done, next_n, c.level_n)
-        next_n = jnp.where(level_done, 0, next_n)
-        qhead = jnp.where(level_done, 0, qhead)
-        level = jnp.where(advance, c.level + 1, c.level)
-        depth = jnp.maximum(c.depth, level)
-
-        return EngineCarry(
-            fps=fps,
-            queue=queue,
-            parity=parity,
-            qhead=qhead,
-            level_n=level_n,
-            next_n=next_n,
-            level=level,
-            depth=depth,
-            generated=generated,
-            distinct=distinct,
-            act_gen=act_gen,
-            act_dist=act_dist,
-            outdeg_hist=outdeg_hist,
-            viol=viol,
-            viol_state=viol_state,
-            viol_action=viol_action,
-        )
-
-    big_body = make_body(chunk)
-    if small:
-        small_body = make_body(small)
-        # break-even: a big step costs ~what chunk/small small steps cost,
-        # so take the big body only when the level remainder mostly fills it
-        def body(c: EngineCarry) -> EngineCarry:
+        def pop_expand(c: EngineCarry):
+            """Expand stage: contiguous pop + backend expand.  Reads only
+            the pre-commit carry (queue buffer `parity`, which the commit
+            stage never writes), so XLA may schedule it alongside the
+            commit of the previous block."""
             avail = c.level_n - c.qhead
-            return lax.cond(avail >= chunk // 2, big_body, small_body, c)
+            n = jnp.clip(avail, 0, ck)
+            rows = jnp.arange(ck, dtype=jnp.int32)
+            mask = rows < n
+            # contiguous pop (the buffer is chunk-padded: no OOB clamping)
+            block = lax.dynamic_slice(
+                c.queue, (c.parity, c.qhead, jnp.int32(0)), (1, ck, W)
+            )[0]
+            batch = cdc.unpack(block)
+            return expand_fn(batch, mask), n
+
+        def commit(c: EngineCarry, ex, n, qhead_pop, qhead_out):
+            """Commit stage for one block's ExpandOut `ex` (`n` popped
+            rows): fpset probe/claim over the sort-compacted candidates,
+            contiguous enqueue, counters, violation merge and level
+            fencing.  `qhead_pop` is the pop cursor right after `ex`'s
+            block was popped (the level-done basis); `qhead_out` is the
+            cursor to keep when the level does not flip (the pipelined
+            fused body passes the post-expand cursor here)."""
+            fp_full = (c.distinct.astype(jnp.int32) + ncand) > int(
+                fp_capacity * fp_highwater
+            )
+            insert_mask = ex.valid & ~fp_full
+            fps, is_new_c, c_idx, nreps = fpset_insert_sorted(
+                c.fps, ex.lo, ex.hi, insert_mask,
+                probe_width=R, claim_width=CW,
+            )
+            n_new = is_new_c.sum().astype(jnp.int32)
+            q_full = c.next_n + n_new > qcap
+
+            # enqueue + per-new-state stats: bring new entries to the
+            # front ordered by original lane index (2-key sort) - the
+            # same append order as the v3 scatter engine, so pop order
+            # and therefore in-batch attribution statistics (outdegree
+            # min/max, MC.out:1104) are preserved bit-for-bit.  All new
+            # entries sit in the first nreps compacted positions, so
+            # when nreps fits the probe width the sort runs at R width
+            # instead of ncand (~6x less comparator traffic); the
+            # full-width branch covers all-distinct bursts.
+            new_key = (~is_new_c).astype(jnp.uint32)
+            cidx_u = c_idx.astype(jnp.uint32)
+
+            def e_sorted_sliced(_):
+                _, e = lax.sort(
+                    (new_key[:R], cidx_u[:R]), num_keys=2, is_stable=True
+                )
+                return jnp.concatenate(
+                    [e, jnp.zeros(ncand - R, jnp.uint32)]
+                )
+
+            def e_sorted_full(_):
+                _, e = lax.sort(
+                    (new_key, cidx_u), num_keys=2, is_stable=True
+                )
+                return e
+
+            if R == ncand:
+                _, e_idx = lax.sort(
+                    (new_key, cidx_u), num_keys=2, is_stable=True
+                )
+            else:
+                e_idx = lax.cond(
+                    nreps <= R, e_sorted_sliced, e_sorted_full, 0
+                )
+            e_idx_p = jnp.concatenate([e_idx, jnp.zeros(A, jnp.uint32)])
+
+            def enq_cond(st):
+                _, _, s = st
+                return s * A < n_new
+
+            def enq_body(st):
+                queue, act_dist, s = st
+                offs = s * A
+                idx_a = lax.dynamic_slice(e_idx_p, (offs,), (A,)).astype(
+                    jnp.int32
+                )
+                active = (jnp.arange(A) + offs) < n_new
+                rows_a = ex.packed[idx_a]  # [A, W] row gather (the only one)
+                woff = jnp.minimum(c.next_n + offs, qcap)
+                queue = lax.dynamic_update_slice(
+                    queue, rows_a[None], (1 - c.parity, woff, jnp.int32(0))
+                )
+                # per-action distinct counts by [A, n_labels] compare-
+                # reduce (scatter-adds cost ~140ns/element on-chip)
+                acts_a = ex.action[idx_a]
+                act_dist = act_dist.at[:n_labels].add(
+                    (
+                        (acts_a[:, None] == label_ids[None, :])
+                        & active[:, None]
+                    ).sum(axis=0).astype(jnp.uint32)
+                )
+                return queue, act_dist, s + 1
+
+            queue, act_dist, _ = lax.while_loop(
+                enq_cond, enq_body, (c.queue, c.act_dist, jnp.int32(0))
+            )
+
+            # outdegree histogram of the popped states (TLC's outdegree =
+            # distinct new successors per expansion, MC.out:1104) via run
+            # lengths: e_idx's active prefix is ascending in source row,
+            # so each row's new-child count is a run length - no
+            # [chunk+1]-bin scatter-add
+            pos = jnp.arange(ncand)
+            active_new = pos < n_new
+            src_e = jnp.where(active_new, e_idx.astype(jnp.int32) // L, -1)
+            startf = jnp.concatenate(
+                [jnp.ones(1, bool), src_e[1:] != src_e[:-1]]
+            ) & active_new
+            endf = jnp.concatenate(
+                [src_e[1:] != src_e[:-1], jnp.ones(1, bool)]
+            ) & active_new
+            run0 = lax.cummax(jnp.where(startf, pos, 0))
+            run_len = jnp.where(endf, pos - run0 + 1, 0)
+            nruns = startf.sum()
+            deg_hist = (
+                (run_len[:, None] == jnp.arange(1, L + 1)[None, :])
+                .sum(axis=0)
+                .astype(jnp.uint32)
+            )
+            outdeg_hist = c.outdeg_hist.at[1 : L + 1].add(deg_hist)
+            outdeg_hist = outdeg_hist.at[0].add(
+                (n - nruns).astype(jnp.uint32)
+            )
+
+            act_gen = c.act_gen.at[:n_labels].add(ex.gen)
+            generated = c.generated + ex.valid.sum().astype(jnp.uint32)
+            distinct = c.distinct + n_new.astype(jnp.uint32)
+
+            # violations, first wins: carried > expand-stage (invariant >
+            # assert > deadlock > slot, pre-reduced in ex) > capacity
+            viol = c.viol
+            viol_state = c.viol_state
+            viol_action = c.viol_action
+            hit = (ex.viol != OK) & (viol == OK)
+            viol = jnp.where(hit, ex.viol, viol)
+            viol_state = jnp.where(hit, ex.viol_state, viol_state)
+            viol_action = jnp.where(hit, ex.viol_action, viol_action)
+            hit = fp_full & ex.valid.any() & (viol == OK)
+            viol = jnp.where(hit, VIOL_FPSET_FULL, viol)
+            hit = q_full & (viol == OK)
+            viol = jnp.where(hit, VIOL_QUEUE_FULL, viol)
+
+            # level bookkeeping: ping-pong at the level boundary
+            next_n = jnp.minimum(c.next_n + n_new, qcap)
+            level_done = qhead_pop >= c.level_n
+            advance = level_done & (next_n > 0)
+            parity = jnp.where(level_done, 1 - c.parity, c.parity)
+            level_n = jnp.where(level_done, next_n, c.level_n)
+            next_n = jnp.where(level_done, 0, next_n)
+            qhead = jnp.where(level_done, 0, qhead_out)
+            level = jnp.where(advance, c.level + 1, c.level)
+            depth = jnp.maximum(c.depth, level)
+
+            return c._replace(
+                fps=fps,
+                queue=queue,
+                parity=parity,
+                qhead=qhead,
+                level_n=level_n,
+                next_n=next_n,
+                level=level,
+                depth=depth,
+                generated=generated,
+                distinct=distinct,
+                act_gen=act_gen,
+                act_dist=act_dist,
+                outdeg_hist=outdeg_hist,
+                viol=viol,
+                viol_state=viol_state,
+                viol_action=viol_action,
+            )
+
+        return pop_expand, commit
+
+    def make_body(ck: int):
+        """One fused BFS step popping up to `ck` states: expand + commit
+        of the SAME block, back to back (the unpipelined body)."""
+        pop_expand, commit = make_stages(ck)
+
+        def body(c: EngineCarry) -> EngineCarry:
+            ex, n = pop_expand(c)
+            return commit(c, ex, n, c.qhead + n, c.qhead + n)
+
+        return body
+
+    if pipeline:
+        pop_expand, commit = make_stages(chunk)
+
+        def with_staged(c: EngineCarry, ex, n) -> EngineCarry:
+            return c._replace(
+                st_packed=ex.packed, st_lo=ex.lo, st_hi=ex.hi,
+                st_valid=ex.valid, st_action=ex.action, st_gen=ex.gen,
+                st_n=n, st_viol=ex.viol, st_viol_state=ex.viol_state,
+                st_viol_action=ex.viol_action,
+            )
+
+        def staged_ex(c: EngineCarry) -> ExpandOut:
+            return ExpandOut(
+                packed=c.st_packed, lo=c.st_lo, hi=c.st_hi,
+                valid=c.st_valid, action=c.st_action, gen=c.st_gen,
+                viol=c.st_viol, viol_state=c.st_viol_state,
+                viol_action=c.st_viol_action,
+            )
+
+        # The two-deep pipeline body, bubble-free: the staged block k-1
+        # commits WHILE block k expands from the PRE-commit carry (the
+        # commit stage never writes the current-level buffer, so the two
+        # halves are data-independent and XLA may overlap them).  At a
+        # level boundary (will_flip: the staged block was the level's
+        # last pop) the expansion instead reads the POST-commit carry -
+        # the freshly flipped level - which serializes that one body but
+        # keeps the body count equal to the unpipelined engine's (no
+        # idle half-bodies: two earlier formulations paid an
+        # fpset-table copy per body through conditional pass-through,
+        # or a full-width empty-commit sort set per level bubble).  The
+        # expand conditional's results are only the staged ExpandOut -
+        # never the table/queue - so the untaken branch costs nothing.
+        def body(c: EngineCarry) -> EngineCarry:
+            will_flip = c.qhead >= c.level_n
+            c2 = commit(c, staged_ex(c), c.st_n, c.qhead, c.qhead)
+
+            def expand_pre(_):
+                return pop_expand(c)
+
+            def expand_post(_):
+                return pop_expand(c2)
+
+            ex, n = lax.cond(will_flip, expand_post, expand_pre, 0)
+            return with_staged(c2._replace(qhead=c2.qhead + n), ex, n)
+
+        def cond(c: EngineCarry):
+            return (
+                (c.qhead < c.level_n) | (c.next_n > 0) | (c.st_n > 0)
+            ) & (c.viol == OK)
+
     else:
-        body = big_body
+        big_body = make_body(chunk)
+        if small:
+            small_body = make_body(small)
+            # break-even: a big step costs ~what chunk/small small steps
+            # cost, so take the big body only when the level remainder
+            # mostly fills it
+            def body(c: EngineCarry) -> EngineCarry:
+                avail = c.level_n - c.qhead
+                return lax.cond(avail >= chunk // 2, big_body, small_body, c)
+        else:
+            body = big_body
 
-    def cond(c: EngineCarry):
-        return ((c.qhead < c.level_n) | (c.next_n > 0)) & (c.viol == OK)
+        def cond(c: EngineCarry):
+            return ((c.qhead < c.level_n) | (c.next_n > 0)) & (c.viol == OK)
 
-    @jax.jit
-    def run_fn(c: EngineCarry) -> EngineCarry:
-        return lax.while_loop(cond, body, c)
+    # donate the carry so XLA aliases the ping-pong queue / staged
+    # candidate buffers in place of copies (CPU has no donation support;
+    # requesting it there only emits warnings)
+    donate_ok = bool(donate) and jax.devices()[0].platform != "cpu"
+    jit_kw = {"donate_argnums": (0,)} if donate_ok else {}
 
-    @jax.jit
-    def step_fn(c: EngineCarry) -> EngineCarry:
-        return lax.cond(cond(c), body, lambda x: x, c)
-
+    run_fn = jax.jit(
+        lambda c: lax.while_loop(cond, body, c), **jit_kw
+    )
+    step_fn = jax.jit(
+        lambda c: lax.cond(cond(c), body, lambda x: x, c), **jit_kw
+    )
     return init_fn, run_fn, step_fn
 
 
@@ -511,6 +614,7 @@ def check(
     fp_index: int = DEFAULT_FP_INDEX,
     seed: int = DEFAULT_SEED,
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
+    pipeline: bool = False,
 ) -> CheckResult:
     """Run an exhaustive check; the single-device engine entry point.
 
@@ -520,7 +624,7 @@ def check(
     way)."""
     init_fn, run_fn, _ = make_engine(
         cfg, chunk, queue_capacity, fp_capacity, fp_index, seed,
-        fp_highwater=fp_highwater,
+        fp_highwater=fp_highwater, pipeline=pipeline,
     )
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()
@@ -717,11 +821,17 @@ def result_from_carry(
     vname = (viol_names or {}).get(viol) or VIOLATION_NAMES.get(
         viol, f"violation {viol}"
     )
+    # a pipelined carry's staged block is popped but uncommitted work -
+    # still "on queue" in TLC's sense (states handed to a worker)
+    staged_n = int(carry.st_n) if carry.st_n is not None else 0
     return CheckResult(
         generated=int(carry.generated),
         distinct=int(carry.distinct),
         depth=int(carry.depth),
-        queue_left=int(carry.level_n) - int(carry.qhead) + int(carry.next_n),
+        queue_left=(
+            int(carry.level_n) - int(carry.qhead) + int(carry.next_n)
+            + staged_n
+        ),
         violation=viol,
         violation_name=vname,
         violation_state=np.asarray(carry.viol_state),

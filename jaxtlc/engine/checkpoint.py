@@ -249,6 +249,7 @@ def check_with_checkpoints(
     max_segments: Optional[int] = None,
     on_progress=None,
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
+    pipeline: bool = False,
 ) -> CheckResult:
     """Exhaustive check with periodic checkpoints every `ckpt_every` chunks.
 
@@ -261,10 +262,17 @@ def check_with_checkpoints(
     (MC.out:35: TLC prints Progress(level) periodically; the fused
     single-dispatch engine has no sync point to report from, this driver
     does).
+
+    Segment dispatch is asynchronous: the snapshot write and progress
+    readback of segment k happen WHILE segment k+1 executes, fencing with
+    jax.block_until_ready only at the next boundary - checkpoint/coverage
+    readback stays off the device critical path (PERF.md round 7).
     """
+    # donate=False: segment k's output is serialized to disk while
+    # segment k+1 (fed the same arrays) is in flight
     init_fn, _, step_fn = make_engine(
         cfg, chunk, queue_capacity, fp_capacity, fp_index, seed,
-        fp_highwater=fp_highwater,
+        fp_highwater=fp_highwater, pipeline=pipeline, donate=False,
     )
     meta = _meta(
         cfg,
@@ -274,6 +282,7 @@ def check_with_checkpoints(
         fp_index=fp_index,
         seed=seed,
         fp_highwater=fp_highwater,
+        pipeline=pipeline,
     )
 
     @jax.jit
@@ -292,32 +301,44 @@ def check_with_checkpoints(
         # the adaptive-step bodies (only the checkpoint CADENCE may change
         # across a resume)
         for key in ("format", "config", "chunk", "queue_capacity",
-                    "fp_capacity", "fp_index", "seed", "fp_highwater"):
-            if saved_meta.get(key) != meta[key]:
+                    "fp_capacity", "fp_index", "seed", "fp_highwater",
+                    "pipeline"):
+            # pre-pipeline snapshots carry no key: treat as False
+            saved = saved_meta.get(key, False if key == "pipeline"
+                                    else None)
+            if saved != meta[key]:
                 raise ValueError(
                     f"checkpoint {key} mismatch: "
-                    f"{saved_meta.get(key)!r} != {meta[key]!r}"
+                    f"{saved!r} != {meta[key]!r}"
                 )
     else:
         carry = template
 
     segments = 0
-    while True:
-        if carry_done(carry):
-            break
+    pending = None  # carry whose snapshot/progress is owed
+    while not carry_done(carry):
         if max_segments is not None and segments >= max_segments:
             break
-        carry = jax.block_until_ready(compiled_segment(carry))
+        in_flight = compiled_segment(carry)  # async dispatch
+        # host work for the PREVIOUS boundary overlaps the running
+        # segment (reading `carry` concurrently is safe: donate=False)
+        if pending is not None:
+            if ckpt_path is not None:
+                save_checkpoint(ckpt_path, pending, meta)
+            if on_progress is not None and not carry_done(pending):
+                st = pending.st_n if pending.st_n is not None else 0
+                d, g, di, ln, qh, nn, sn = jax.device_get(
+                    (pending.depth, pending.generated, pending.distinct,
+                     pending.level_n, pending.qhead, pending.next_n, st)
+                )
+                on_progress(int(d), int(g), int(di),
+                            int(ln) - int(qh) + int(nn) + int(sn))
+        carry = jax.block_until_ready(in_flight)
         segments += 1
-        if ckpt_path is not None:
-            save_checkpoint(ckpt_path, carry, meta)
-        if on_progress is not None and not carry_done(carry):
-            on_progress(
-                int(carry.depth),
-                int(carry.generated),
-                int(carry.distinct),
-                int(carry.level_n) - int(carry.qhead) + int(carry.next_n),
-            )
+        pending = carry
+    # the last boundary has no next segment to hide behind
+    if pending is not None and ckpt_path is not None:
+        save_checkpoint(ckpt_path, pending, meta)
 
     wall = time.time() - t0
     from .fpset import fpset_actual_collision

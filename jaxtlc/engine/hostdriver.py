@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -71,19 +72,40 @@ def host_bfs(
 
     pad_template = np.zeros((chunk, F), dtype=np.int32)
 
+    def dispatch(buf: np.ndarray):
+        """Enqueue kernel + invariant evaluation for one padded chunk
+        (asynchronous: jax dispatch returns in-flight arrays)."""
+        succs, valid, action, afail, ovf = kern(jnp.asarray(buf))
+        inv_bits = inv_kern(jnp.asarray(succs.reshape(-1, F)))
+        return succs, valid, action, afail, ovf, inv_bits
+
     while frontier:
         if on_level is not None:
             on_level(depth, frontier)
         nxt: List[np.ndarray] = []
+        # chunk-level software pipeline: chunk i+1's kernel is dispatched
+        # BEFORE chunk i's results are pulled to host, so the Python
+        # dict/dedup work below overlaps device execution; the pull
+        # itself is ONE batched device_get instead of five blocking
+        # conversions (the supervisor's async-readback discipline,
+        # PERF.md round 7, applied to the oracle-adjacent driver)
+        chunks: List[Tuple[np.ndarray, int]] = []
         for base in range(0, len(frontier), chunk):
             batch = frontier[base : base + chunk]
             n = len(batch)
             buf = pad_template.copy()
             buf[:n] = np.stack(batch)
-            succs, valid, action, afail, ovf = kern(jnp.asarray(buf))
-            inv_bits = np.asarray(
-                inv_kern(jnp.asarray(succs.reshape(-1, F)))
-            ).reshape(chunk, -1)
+            chunks.append((buf, n))
+        in_flight = dispatch(chunks[0][0]) if chunks else None
+        for i, (buf, n) in enumerate(chunks):
+            current = in_flight
+            in_flight = (
+                dispatch(chunks[i + 1][0]) if i + 1 < len(chunks) else None
+            )
+            succs, valid, action, afail, ovf, inv_bits = jax.device_get(
+                current
+            )
+            inv_bits = np.asarray(inv_bits).reshape(chunk, -1)
             succs = np.asarray(succs)
             valid = np.array(valid)
             valid[n:] = False

@@ -132,6 +132,19 @@ def migrate_engine_carry(
         fps2 = FPSet(jnp.asarray(np.asarray(carry.fps.table)))
         assert fps2.table.shape[0] * BUCKET == fp_cap2
 
+    # pipelined staged block (expand-stage output awaiting commit):
+    # geometry-independent - packed candidate rows + raw fingerprint
+    # words travel verbatim; the replayed segment commits them against
+    # the regrown table/queue through the normal insert path
+    staged = {}
+    if carry.st_n is not None:
+        staged = {
+            f: jnp.asarray(np.asarray(getattr(carry, f)))
+            for f in ("st_packed", "st_lo", "st_hi", "st_valid",
+                      "st_action", "st_gen", "st_n", "st_viol",
+                      "st_viol_state", "st_viol_action")
+        }
+
     return EngineCarry(
         fps=fps2,
         queue=jnp.asarray(queue2),
@@ -149,6 +162,7 @@ def migrate_engine_carry(
         viol=jnp.int32(int(carry.viol)),
         viol_state=jnp.asarray(np.asarray(carry.viol_state), jnp.int32),
         viol_action=jnp.int32(int(carry.viol_action)),
+        **staged,
     )
 
 
@@ -162,8 +176,25 @@ def migrate_shard_carry(
     The circular per-device frontier is renumbered to qhead=0 when the
     queue grows (positions are pop-order-preserving: entry i of the
     in-flight window lands at slot i).  route_factor growth changes only
-    the engine's all_to_all bucket width - the carry passes through."""
+    the engine's all_to_all bucket width - the carry passes through,
+    except a PIPELINED carry's pending-verdict buffers, which are sized
+    by that width: their statistics are drained host-side first and the
+    buffers re-seated empty at the new width."""
     D = int(np.asarray(carry.qhead).shape[0])
+    if carry.pv_n is not None:
+        old_B = int(np.asarray(carry.pv_send).shape[2])
+        ncand = int(np.asarray(carry.pv_sown).shape[1])
+        L = int(np.asarray(carry.outdeg_hist).shape[1]) - 2
+        from ..engine.sharded import drain_pending_host, route_bucket_width
+
+        new_B = route_bucket_width(
+            ncand // L, L, D, float(new_params.get("route_factor", 2.0))
+        )
+        if new_B != old_B:
+            carry = drain_pending_host(carry)
+            carry = carry._replace(
+                pv_send=jnp.zeros((D, D, new_B), jnp.uint8)
+            )
     qcap = int(old_params["queue_capacity"])
     qcap2 = int(new_params["queue_capacity"])
     fp_cap = int(old_params["fp_capacity"])
@@ -201,6 +232,13 @@ def migrate_shard_carry(
         qtail2 = np.asarray(carry.qtail)
         level_end2 = np.asarray(carry.level_end)
 
+    pv = {}
+    if carry.pv_n is not None:
+        pv = {
+            f: jnp.asarray(np.asarray(getattr(carry, f)))
+            for f in ("pv_send", "pv_sown", "pv_pos", "pv_svalid",
+                      "pv_order", "pv_faction", "pv_n")
+        }
     return ShardCarry(
         table=jnp.asarray(table2),
         queue=jnp.asarray(queue2),
@@ -218,4 +256,5 @@ def migrate_shard_carry(
         viol_state=jnp.asarray(np.asarray(carry.viol_state), jnp.int32),
         viol_local=jnp.asarray(np.asarray(carry.viol_local), bool),
         cont=jnp.asarray(np.asarray(carry.cont), bool),
+        **pv,
     )

@@ -179,13 +179,13 @@ class SingleDeviceAdapter:
     kind = "single"
     GEOM_KEYS = ("queue_capacity", "fp_capacity")
     FIXED_KEYS = ("format", "config", "chunk", "fp_index", "seed",
-                  "fp_highwater")
+                  "fp_highwater", "pipeline")
 
     def __init__(self, cfg, chunk: int = 1024,
                  fp_index: int = DEFAULT_FP_INDEX, seed: int = DEFAULT_SEED,
                  fp_highwater: float = DEFAULT_FP_HIGHWATER,
                  backend=None, meta_config: dict = None,
-                 check_deadlock: bool = True):
+                 check_deadlock: bool = True, pipeline: bool = False):
         self.cfg = cfg
         self.chunk = chunk
         self.fp_index = fp_index
@@ -194,8 +194,12 @@ class SingleDeviceAdapter:
         self.backend = backend
         self.meta_config = meta_config
         self.check_deadlock = check_deadlock
+        self.pipeline = pipeline
 
     def build(self, params: dict, ckpt_every: int):
+        # donate=False: the supervisor feeds the SAME last-good carry
+        # back into the segment on retry/regrow and checkpoints it while
+        # the next segment is in flight - donation would invalidate it
         if self.backend is not None:
             from ..engine.bfs import make_backend_engine
 
@@ -204,12 +208,14 @@ class SingleDeviceAdapter:
                 params["fp_capacity"], self.fp_index, self.seed,
                 fp_highwater=self.fp_highwater,
                 check_deadlock=self.check_deadlock,
+                pipeline=self.pipeline, donate=False,
             )
         else:
             init_fn, _, step_fn = make_engine(
                 self.cfg, self.chunk, params["queue_capacity"],
                 params["fp_capacity"], self.fp_index, self.seed,
                 fp_highwater=self.fp_highwater,
+                pipeline=self.pipeline, donate=False,
             )
 
         @jax.jit
@@ -218,13 +224,18 @@ class SingleDeviceAdapter:
 
         template = init_fn()
         compiled = segment.lower(template).compile()
-        return template, lambda c: jax.block_until_ready(compiled(c))
+        # async contract: seg_fn DISPATCHES and returns in-flight arrays;
+        # the supervision loop overlaps host work (checkpoint write,
+        # stats readback of the previous carry) with the running segment
+        # and fences with jax.block_until_ready
+        return template, compiled
 
     def meta(self, params: dict) -> dict:
         return ckpt._meta(
             self.cfg, meta_config=self.meta_config, chunk=self.chunk,
             fp_index=self.fp_index, seed=self.seed,
-            fp_highwater=self.fp_highwater, **params,
+            fp_highwater=self.fp_highwater, pipeline=self.pipeline,
+            **params,
         )
 
     def viol(self, carry) -> int:
@@ -234,9 +245,16 @@ class SingleDeviceAdapter:
         return carry_done(carry)
 
     def progress(self, carry):
+        # one batched device_get instead of four blocking scalar pulls;
+        # a pipelined carry's staged block counts as queued work
+        st = carry.st_n if carry.st_n is not None else 0
+        d, g, di, ln, qh, nn, sn = jax.device_get(
+            (carry.depth, carry.generated, carry.distinct,
+             carry.level_n, carry.qhead, carry.next_n, st)
+        )
         return (
-            int(carry.depth), int(carry.generated), int(carry.distinct),
-            int(carry.level_n) - int(carry.qhead) + int(carry.next_n),
+            int(d), int(g), int(di),
+            int(ln) - int(qh) + int(nn) + int(sn),
         )
 
     def migrate(self, carry, old_params: dict, new_params: dict):
@@ -263,11 +281,13 @@ class ShardedAdapter:
 
     kind = "sharded"
     GEOM_KEYS = ("queue_capacity", "fp_capacity", "route_factor")
-    FIXED_KEYS = ("format", "config", "devices", "fp_highwater")
+    FIXED_KEYS = ("format", "config", "devices", "fp_highwater",
+                  "pipeline")
 
     def __init__(self, cfg, mesh, chunk: int = 512, backend=None,
                  meta_config: dict = None,
-                 fp_highwater: float = DEFAULT_FP_HIGHWATER):
+                 fp_highwater: float = DEFAULT_FP_HIGHWATER,
+                 pipeline: bool = False):
         from ..engine.sharded import kubeapi_backend
 
         self.cfg = cfg
@@ -276,6 +296,7 @@ class ShardedAdapter:
         self.backend = backend if backend is not None else kubeapi_backend(cfg)
         self.meta_config = meta_config
         self.fp_highwater = fp_highwater
+        self.pipeline = pipeline
 
     def build(self, params: dict, ckpt_every: int):
         from ..engine.sharded import make_sharded_engine
@@ -285,16 +306,19 @@ class ShardedAdapter:
             params["queue_capacity"], params["fp_capacity"],
             route_factor=params["route_factor"], segment=ckpt_every,
             backend=self.backend, fp_highwater=self.fp_highwater,
+            pipeline=self.pipeline,
         )
         template = init_fn()
         compiled = seg_fn.lower(template).compile()
-        return template, lambda c: jax.block_until_ready(compiled(c))
+        # async contract: dispatch only; the supervision loop fences
+        return template, compiled
 
     def meta(self, params: dict) -> dict:
         return ckpt._meta(
             self.cfg, meta_config=self.meta_config, chunk=self.chunk,
             devices=int(self.mesh.devices.size),
-            fp_highwater=self.fp_highwater, **params,
+            fp_highwater=self.fp_highwater, pipeline=self.pipeline,
+            **params,
         )
 
     def viol(self, carry) -> int:
@@ -304,11 +328,16 @@ class ShardedAdapter:
         return not bool(np.asarray(carry.cont).any())
 
     def progress(self, carry):
+        # one batched device_get instead of five blocking pulls
+        d, g, di, qt, qh = jax.device_get(
+            (carry.depth, carry.generated, carry.distinct,
+             carry.qtail, carry.qhead)
+        )
         return (
-            int(np.asarray(carry.depth).max()),
-            int(np.asarray(carry.generated).sum()),
-            int(np.asarray(carry.distinct).sum()),
-            int((np.asarray(carry.qtail) - np.asarray(carry.qhead)).sum()),
+            int(np.asarray(d).max()),
+            int(np.asarray(g).sum()),
+            int(np.asarray(di).sum()),
+            int((np.asarray(qt) - np.asarray(qh)).sum()),
         )
 
     def migrate(self, carry, old_params: dict, new_params: dict):
@@ -335,10 +364,13 @@ def _params_from_meta(adapter, meta: dict, params: dict) -> dict:
     travel with the snapshot, so the resume command needs none of them)."""
     want = adapter.meta(params)
     for key in adapter.FIXED_KEYS:
-        if meta.get(key) != want.get(key):
+        # pre-pipeline snapshots carry no "pipeline" key: they were cut
+        # from the unpipelined engine, so missing means False
+        have = meta.get(key, False if key == "pipeline" else None)
+        if have != want.get(key):
             raise ValueError(
                 f"checkpoint {key} mismatch: "
-                f"{meta.get(key)!r} != {want.get(key)!r}"
+                f"{have!r} != {want.get(key)!r}"
             )
     out = dict(params)
     for key in adapter.GEOM_KEYS:
@@ -449,6 +481,25 @@ def supervise(adapter, params: dict,
         return path
 
     good = carry
+    # deferred periodic checkpoint: written while the NEXT segment is in
+    # flight, so snapshot serialization/fsync overlaps device execution
+    # instead of stalling the step loop (the carry is safe to read
+    # concurrently because the engines are built donate=False here)
+    pending_save = None
+
+    def flush_save():
+        nonlocal pending_save
+        if pending_save is None:
+            return
+        c = pending_save
+        pending_save = None
+        try:
+            save(c, "periodic")
+        except OSError as e:
+            # a failed snapshot write must not kill a healthy run; the
+            # next segment boundary retries
+            _emit(opts, "ckpt_write_failed", error=str(e))
+
     with _SignalCatcher() as sig:
         while not adapter.done(carry):
             if sig.hit is not None:
@@ -460,7 +511,11 @@ def supervise(adapter, params: dict,
             while True:
                 try:
                     faults.segment_start(segments)
-                    carry2 = seg_fn(good)
+                    in_flight = seg_fn(good)
+                    # host work overlapping the running segment: the
+                    # previous segment's checkpoint write + progress line
+                    flush_save()
+                    carry2 = jax.block_until_ready(in_flight)
                     break
                 except _TRANSIENT as e:
                     if attempt >= opts.retries:
@@ -495,10 +550,12 @@ def supervise(adapter, params: dict,
                     break
                 new_params = grown(params, resource)
                 t = time.time()
-                if resource == "route_factor":
-                    migrated = good  # engine-geometry-only knob
-                else:
-                    migrated = adapter.migrate(good, params, new_params)
+                # route_factor is an engine-geometry-only knob for the
+                # carry's containers, but a PIPELINED sharded carry sizes
+                # its pending-verdict buffers by the route bucket width -
+                # migrate() drains + re-seats them (pass-through
+                # otherwise)
+                migrated = adapter.migrate(good, params, new_params)
                 template, seg_fn = adapter.build(
                     new_params, opts.ckpt_every
                 )
@@ -526,18 +583,16 @@ def supervise(adapter, params: dict,
             good = carry2
             segments += 1
             if opts.ckpt_path:
-                try:
-                    save(good, "periodic")
-                except OSError as e:
-                    # a failed snapshot write must not kill a healthy
-                    # run; the next segment boundary retries
-                    _emit(opts, "ckpt_write_failed", error=str(e))
+                pending_save = good
             if adapter.viol(carry) == OK and not adapter.done(carry):
                 d, g, di, q = adapter.progress(carry)
                 _emit(opts, "progress", depth=d, generated=g,
                       distinct=di, queue=q)
 
+        # the final segment's snapshot has no next segment to hide
+        # behind: write it at the fence
         if interrupted:
+            pending_save = None  # superseded by the final generation
             path = None
             try:
                 path = save(good, "final")
@@ -545,6 +600,8 @@ def supervise(adapter, params: dict,
                 _emit(opts, "ckpt_write_failed", error=str(e))
             _emit(opts, "interrupted",
                   signum=int(sig.hit) if sig.hit else None, path=path)
+        else:
+            flush_save()
 
     result = adapter.result(carry, time.time() - t0, segments, params)
     return SupervisedResult(
@@ -571,6 +628,7 @@ def check_supervised(
     backend=None,
     meta_config: dict = None,
     check_deadlock: bool = True,
+    pipeline: bool = False,
     opts: SupervisorOptions = None,
 ) -> SupervisedResult:
     """Supervised single-device exhaustive check (the check_with_
@@ -581,6 +639,7 @@ def check_supervised(
         cfg, chunk=chunk, fp_index=fp_index, seed=seed,
         fp_highwater=fp_highwater, backend=backend,
         meta_config=meta_config, check_deadlock=check_deadlock,
+        pipeline=pipeline,
     )
     return supervise(
         adapter,
@@ -599,12 +658,13 @@ def check_sharded_supervised(
     backend=None,
     meta_config: dict = None,
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
+    pipeline: bool = False,
     opts: SupervisorOptions = None,
 ) -> SupervisedResult:
     """Supervised mesh-sharded exhaustive check (capacities PER DEVICE)."""
     adapter = ShardedAdapter(
         cfg, mesh, chunk=chunk, backend=backend, meta_config=meta_config,
-        fp_highwater=fp_highwater,
+        fp_highwater=fp_highwater, pipeline=pipeline,
     )
     return supervise(
         adapter,

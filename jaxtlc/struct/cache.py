@@ -101,6 +101,7 @@ def get_engine(
     seed: int,
     fp_highwater: float,
     check_deadlock: bool = True,
+    pipeline: bool = False,
 ) -> Tuple:
     """Memoized single-device engine triple (init_fn, run_fn, step_fn)
     for a struct model; enables the persistent XLA cache as a side
@@ -111,13 +112,14 @@ def get_engine(
     key = (
         model_key(model), "single", chunk, queue_capacity, fp_capacity,
         fp_index, seed, fp_highwater, bool(check_deadlock),
+        bool(pipeline),
     )
     hit = _ENGINE_MEMO.get(key)
     if hit is None:
         backend = get_backend(model, check_deadlock)
         hit = make_backend_engine(
             backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
-            fp_highwater=fp_highwater,
+            fp_highwater=fp_highwater, pipeline=pipeline,
         )
         _ENGINE_MEMO[key] = hit
     return hit

@@ -627,14 +627,34 @@ class LaneCompiler:
             c = self.comp(ast[1], env, ctx)
             if isinstance(c, LC):
                 return self.comp(ast[2] if c.value else ast[3], env, ctx)
-            t = self.comp(ast[2], env, ctx)
-            e = self.comp(ast[3], env, ctx)
+            # effects (trap/ovf/afail) raised inside a branch only count
+            # when that branch is SELECTED: the host evaluator never
+            # looks at the untaken branch, so e.g. LastTerm's
+            # `IF Len(s) = 0 THEN 0 ELSE s[Len(s)]` must not trap on the
+            # ELSE read when Len(s) = 0 (the RaftReplication device break)
+            t, t_fx = self._comp_branch(ast[2], env, ctx)
+            e, e_fx = self._comp_branch(ast[3], env, ctx)
+            self._merge_branch_fx(ctx, c, t_fx, e_fx)
             return self.select(c, t, e)
         if op == "case":
-            arms = [(self.comp(g, env, ctx), self.comp(e, env, ctx))
-                    for g, e in ast[1]]
-            out = self.comp(ast[2], env, ctx) if ast[2] is not None \
-                else arms[-1][1]
+            arms = []
+            for g_ast, e_ast in ast[1]:
+                g = self.comp(g_ast, env, ctx)
+                e, fx = self._comp_branch(e_ast, env, ctx)
+                # arm effects gated by the arm's own guard (a sound
+                # over-approximation when several guards hold; TLA CASE
+                # is nondeterministic among them anyway)
+                self._merge_branch_fx(ctx, g, fx, None)
+                arms.append((g, e))
+            if ast[2] is not None:
+                any_g = LC(False)
+                for g, _ in arms:
+                    any_g = self._lor(any_g, g)
+                o, fx = self._comp_branch(ast[2], env, ctx)
+                self._merge_branch_fx(ctx, self._lnot(any_g), fx, None)
+                out = o
+            else:
+                out = arms[-1][1]
             for g, e in reversed(arms):
                 if isinstance(g, LC):
                     out = e if g.value else out
@@ -717,6 +737,40 @@ class LaneCompiler:
             return LC(True) if b.value else a
         x, y, d = _binop_arrs(a.arr, a.depth, b.arr, b.depth)
         return LB(x | y, d)
+
+    _FX = ("trap", "ovf", "afail")
+
+    def _comp_branch(self, ast, env, ctx):
+        """Compile `ast` with the effect accumulators (trap/ovf/afail)
+        swapped out, returning (value, {effect: LB}) so the caller can
+        re-apply them gated by the branch condition.  The guard is NOT
+        swapped: it belongs to the lane, not the expression."""
+        saved = {f: getattr(ctx, f) for f in self._FX}
+        for f in self._FX:
+            setattr(ctx, f, LC(False))
+        v = self.comp(ast, env, ctx)
+        fx = {f: getattr(ctx, f) for f in self._FX}
+        for f in self._FX:
+            setattr(ctx, f, saved[f])
+        return v, fx
+
+    def _merge_branch_fx(self, ctx, cond, t_fx, e_fx):
+        """Fold branch effects into ctx, each gated by its branch being
+        the one selected.  A non-boolean condition degrades to the old
+        ungated behavior (sound: traps at worst too eagerly)."""
+        gateable = isinstance(cond, (LB, LC))
+        for f in self._FX:
+            for fx, gate in ((t_fx, cond),
+                             (e_fx, self._lnot(cond) if gateable
+                              else None)):
+                if fx is None:
+                    continue
+                eff = fx[f]
+                if isinstance(eff, LC) and not eff.value:
+                    continue
+                if gateable:
+                    eff = self._land(gate, eff)
+                setattr(ctx, f, self._lor(getattr(ctx, f), eff))
 
     def _comp_apply(self, ast, env, ctx) -> LV:
         base = self.comp(ast[1], env, ctx)

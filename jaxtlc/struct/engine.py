@@ -51,12 +51,13 @@ def check_struct(
     seed: int = DEFAULT_SEED,
     check_deadlock: bool = True,
     fp_highwater: float = 0.85,
+    pipeline: bool = False,
 ) -> CheckResult:
     """Exhaustive device check of a struct-compiled spec (single device,
     fused loop; AOT-compiled before timing like bfs.check)."""
     init_fn, run_fn, _ = get_engine(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
-        fp_highwater, check_deadlock=check_deadlock,
+        fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
     )
     backend = get_backend(model, check_deadlock)
     carry = init_fn()
@@ -78,6 +79,7 @@ def check_struct_sharded(
     fp_capacity: int = 1 << 18,
     route_factor: float = 2.0,
     check_deadlock: bool = True,
+    pipeline: bool = False,
 ) -> CheckResult:
     """Exhaustive mesh-sharded check of a struct-compiled spec
     (capacities PER DEVICE; fingerprint-space all_to_all partitioning,
@@ -88,5 +90,5 @@ def check_struct_sharded(
     return check_sharded(
         None, mesh, chunk=chunk, queue_capacity=queue_capacity,
         fp_capacity=fp_capacity, route_factor=route_factor,
-        backend=backend,
+        backend=backend, pipeline=pipeline,
     )

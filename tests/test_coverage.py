@@ -11,6 +11,7 @@ minus the initial states, and each action's distinct never exceeds MC.out's
 generated for it.
 """
 
+import os
 import re
 
 import pytest
@@ -19,6 +20,12 @@ from jaxtlc.config import MODEL_1
 from jaxtlc.engine.bfs import check
 
 MC_OUT = "/root/reference/KubeAPI.toolbox/Model_1/MC.out"
+
+# skip (not fail) when the reference toolbox isn't mounted, so tier-1
+# red always means a real regression (PR 3's struct-test guard pattern)
+needs_reference = pytest.mark.skipif(
+    not os.path.exists(MC_OUT), reason="reference toolbox not mounted"
+)
 _ACTION = re.compile(r"^<(\w+) line \d+.*>: (\d+):(\d+)$")
 
 
@@ -33,6 +40,7 @@ def reference_action_coverage():
     return out
 
 
+@needs_reference
 def test_mc_out_parses():
     ref = reference_action_coverage()
     assert ref["Init"] == (2, 2)

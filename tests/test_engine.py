@@ -109,6 +109,50 @@ def test_engine_detects_seeded_assert_violation():
     assert int(out.viol) == VIOL_ASSERT
 
 
+def _full_signature(r):
+    return (r.generated, r.distinct, r.depth, r.violation, r.queue_left,
+            tuple(sorted(r.action_generated.items())),
+            tuple(sorted(r.action_distinct.items())),
+            r.outdegree, r.fp_occupancy, r.actual_fp_collision)
+
+
+def test_pipelined_engine_bit_identical_ff():
+    """ISSUE 4 acceptance: the software-pipelined step schedule changes
+    WHEN work happens, never what.  One engine pair, two pins: the full
+    result signature (counts, depth, per-action, outdegree, occupancy)
+    AND the final fingerprint TABLE word-for-word - the pipelined engine
+    inserted exactly the same fingerprints through exactly the same
+    claims as the fused engine at the same chunk."""
+    from jaxtlc.engine.bfs import make_engine, result_from_carry
+
+    kw = dict(chunk=256, queue_capacity=1 << 13, fp_capacity=1 << 15)
+    outs = []
+    for pipelined in (False, True):
+        init_fn, run_fn, _ = make_engine(FF, pipeline=pipelined, **kw)
+        out = run_fn(init_fn())
+        assert int(out.viol) == 0
+        outs.append(out)
+    a, b = (
+        result_from_carry(o, 0.0, fp_capacity=kw["fp_capacity"])
+        for o in outs
+    )
+    assert _full_signature(a) == _full_signature(b)
+    assert np.array_equal(
+        np.asarray(outs[0].fps.table), np.asarray(outs[1].fps.table)
+    )
+
+
+@pytest.mark.slow
+def test_pipelined_model1_full_signature():
+    """Model_1 (the TLC-comparable workload): pipelined vs unpipelined
+    bit-for-bit on the full signature - the ISSUE 4 acceptance pin."""
+    kw = dict(chunk=1024, queue_capacity=1 << 15, fp_capacity=1 << 20)
+    a = check(MODEL_1, **kw)
+    b = check(MODEL_1, pipeline=True, **kw)
+    assert (a.generated, a.distinct, a.depth) == (577736, 163408, 124)
+    assert _full_signature(a) == _full_signature(b)
+
+
 @pytest.mark.slow
 def test_device_engine_model1_exact_tlc_parity():
     r = check(MODEL_1, chunk=1024, queue_capacity=1 << 15, fp_capacity=1 << 20)

@@ -16,7 +16,15 @@ CFG = os.path.join(REF, "Model_1", "MC.cfg")
 TLA = os.path.join(REF, "Model_1", "MC.tla")
 LAUNCH = os.path.join(REF, "KubeAPI___Model_1.launch")
 
+# reference-artifact tests skip (not fail) when the toolbox isn't
+# mounted, so tier-1 red always means a real regression (PR 3's guard
+# pattern for the struct tests, applied to the remaining seed tests)
+needs_reference = pytest.mark.skipif(
+    not os.path.exists(REF), reason="reference toolbox not mounted"
+)
 
+
+@needs_reference
 def test_parse_reference_mc_cfg():
     cfg = parse_cfg_file(CFG)
     assert cfg.specification == "Spec"
@@ -25,6 +33,7 @@ def test_parse_reference_mc_cfg():
     assert set(cfg.substitutions) == {"REQUESTS_CAN_FAIL", "REQUESTS_CAN_TIMEOUT"}
 
 
+@needs_reference
 def test_parse_reference_mc_tla():
     mc = parse_mc_tla_file(TLA)
     assert mc.extends == ["KubeAPI", "TLC"]
@@ -33,6 +42,7 @@ def test_parse_reference_mc_tla():
         assert eval_constant(body) is True
 
 
+@needs_reference
 def test_parse_reference_launch():
     l = parse_launch_file(LAUNCH)
     assert l.spec_name == "KubeAPI"
@@ -47,6 +57,7 @@ def test_parse_reference_launch():
     assert l.distributed_fpset_count == 0
 
 
+@needs_reference
 def test_resolve_reference_model():
     spec = resolve(CFG)
     assert spec.model.requests_can_fail is True

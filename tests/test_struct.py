@@ -9,6 +9,7 @@ log's exact counts (MC.out:32,1098,1101) and per-action coverage totals
 """
 
 import dataclasses
+import os
 
 import pytest
 
@@ -24,6 +25,13 @@ from jaxtlc.struct.parser import parse_expression, parse_module
 # plain module mc_expect (importable as top-level from any test module)
 from mc_expect import MC_OUT_ACTIONS, REF_CFG  # noqa: F401
 
+# skip (not fail) when the reference toolbox isn't mounted, so tier-1
+# red always means a real regression (matches the guards on the struct
+# engine tests PR 3 added)
+needs_reference = pytest.mark.skipif(
+    not os.path.exists(REF_CFG), reason="reference toolbox not mounted"
+)
+
 
 def _load(fail: bool, timeout: bool):
     return load(REF_CFG, const_overrides={
@@ -36,6 +44,7 @@ def _load(fail: bool, timeout: bool):
 # ---------------------------------------------------------------------------
 
 
+@needs_reference
 def test_parse_reference_module():
     with open("/root/reference/KubeAPI.tla") as f:
         mod = parse_module(f.read())
@@ -97,6 +106,7 @@ def test_assert_raises():
 # ---------------------------------------------------------------------------
 
 
+@needs_reference
 def test_reference_initial_states():
     m = load(REF_CFG)
     assert m.root_name == "KubeAPI"
@@ -108,6 +118,7 @@ def test_reference_initial_states():
     assert set(m.invariants) == {"TypeOK", "OnlyOneVersion"}
 
 
+@needs_reference
 def test_ff_corner_counts_and_state_set():
     """FF corner: exact counts AND state-set equality vs the hand oracle
     (the same differential that pinned the hand kernel, SURVEY.md §4)."""

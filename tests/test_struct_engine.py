@@ -148,6 +148,29 @@ def test_twophase_sharded_matches_single_device():
         sum(single.action_distinct.values())
 
 
+def test_twophase_pipelined_bit_identical():
+    """The struct LaneCompiler path inherits the pipelined step through
+    the SpecBackend seam (ISSUE 4): full-signature bit-equality against
+    the fused struct engine, no struct-specific pipeline code."""
+    m = load("specs/TwoPhase.toolbox/Model_1/MC.cfg")
+    kw = dict(chunk=64, queue_capacity=1 << 10, fp_capacity=1 << 12,
+              check_deadlock=False)
+    a = check_struct(m, **kw)
+    b = check_struct(m, pipeline=True, **kw)
+    assert (a.generated, a.distinct, a.depth) == (114, 56, 8)
+    assert (
+        (a.generated, a.distinct, a.depth, a.violation, a.queue_left,
+         tuple(sorted(a.action_generated.items())),
+         tuple(sorted(a.action_distinct.items())), a.outdegree,
+         a.fp_occupancy)
+        ==
+        (b.generated, b.distinct, b.depth, b.violation, b.queue_left,
+         tuple(sorted(b.action_generated.items())),
+         tuple(sorted(b.action_distinct.items())), b.outdegree,
+         b.fp_occupancy)
+    )
+
+
 @needs_reference
 @pytest.mark.slow
 def test_kubeapi_ff_device():

@@ -1,0 +1,38 @@
+"""Tooling smoke: the profiler must not silently rot (ISSUE 4).
+
+tools/profile_v4.py is the instrument every PERF.md round leans on; a
+broken import or a drifted engine signature must show up in tier-1, not
+on the next TPU session.  --tiny runs the WHOLE profiler (every phase
+closure plus the round-7 expand/commit attribution and the pipelined
+step timing) on the FF corner in-process.
+"""
+
+import importlib.util
+import io
+import os
+from contextlib import redirect_stdout
+
+
+def test_profile_v4_tiny_smoke(capsys):
+    spec = importlib.util.spec_from_file_location(
+        "profile_v4",
+        os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "profile_v4.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        mod.main(["--tiny"])
+    out = buf.getvalue()
+    # every stage line the PERF rounds read must be present
+    for needle in (
+        "vmap(step) expansion",
+        "fpset_insert_sorted",
+        "REAL step_fn",
+        "expand stage (seam)",
+        "commit stage (real step - expand)",
+        "PIPELINED step_fn",
+        "overlap efficiency:",
+    ):
+        assert needle in out, f"profiler output lost {needle!r}:\n{out}"

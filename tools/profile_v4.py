@@ -3,10 +3,17 @@
 Unlike tools/profile_scaled.py (whose host-side random-walk setup is
 unusably slow at 128k chunks), this drives the REAL engine to a mid-run
 carry (realistic frontier block + realistic table load), then times each
-phase of bfs.step_body in a fused ``lax.fori_loop`` so the tunneled
+phase of the engine step in a fused ``lax.fori_loop`` so the tunneled
 dispatch floor (~64 ms) is amortized and subtracted.
 
+Round 7 additions: per-stage wall attribution for the pipelined engine
+(expand stage measured directly through the backend seam, commit stage
+by subtraction from the real fused step) and an overlap-efficiency line
+(wall saved by the pipelined step over min(expand, commit), the
+theoretical two-stage overlap ceiling).
+
 Usage: python tools/profile_v4.py [--chunk N] [--fpcap LOG2] [--steps K]
+       python tools/profile_v4.py --tiny   # FF corner smoke (tier-1)
 """
 
 import argparse
@@ -48,14 +55,31 @@ def fused_time(name, body, carry, floor_s=0.0, reps=3):
     return out, per
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--chunk", type=int, default=131072)
     ap.add_argument("--fpcap", type=int, default=26)
     ap.add_argument("--steps", type=int, default=60)
-    args = ap.parse_args()
+    ap.add_argument("--tiny", action="store_true",
+                    help="FF-corner smoke sizing (chunk 256, fp 2^15, "
+                         "8 warm steps) so the tier-1 suite can run the "
+                         "whole profiler without a TPU")
+    args = ap.parse_args(argv)
 
-    cfg, _ = scaled_config()
+    if args.tiny:
+        from jaxtlc.config import ModelConfig
+
+        cfg = ModelConfig(False, False)
+        if args.chunk == 131072:
+            args.chunk = 256
+        if args.fpcap == 26:
+            args.fpcap = 15
+        if args.steps == 60:
+            args.steps = 8
+        qcap = 1 << 13
+    else:
+        cfg, _ = scaled_config()
+        qcap = 1 << 21
     cdc = get_codec(cfg)
     F = cdc.n_fields
     W = (cdc.nbits + 31) // 32
@@ -67,9 +91,11 @@ def main():
     print(f"chunk={chunk} L={L} F={F} W={W} nbits={cdc.nbits} "
           f"ncand={ncand} dev={jax.devices()[0]}")
 
-    # drive the real engine to a mid-run carry
+    # drive the real engine to a mid-run carry (donate=False: the same
+    # warmed carry seeds every timing closure below, repeatedly)
     init_fn, _, step_fn = make_engine(
-        cfg, chunk=chunk, queue_capacity=1 << 21, fp_capacity=1 << args.fpcap
+        cfg, chunk=chunk, queue_capacity=qcap,
+        fp_capacity=1 << args.fpcap, donate=False,
     )
     carry = init_fn()
     t0 = time.time()
@@ -247,6 +273,59 @@ def main():
     # dispatch floor per call (floor_s = one fused-loop dispatch's cost)
     per = best / K - floor_s
     print(f"{'REAL step_fn (x16, floor-adjusted)':40s} {per * 1e3:9.3f} ms/iter")
+
+    # --- round 7: expand/commit stage attribution + overlap efficiency ---
+    # expand measured directly through the backend seam (the SAME
+    # function the pipelined body runs); commit attributed by
+    # subtraction from the real fused step so the two columns add up to
+    # what the engine actually pays
+    from jaxtlc.engine.backend import kubeapi_backend, make_expand_stage
+
+    backend = kubeapi_backend(cfg)
+    expand_fn = make_expand_stage(
+        backend, chunk, True, DEFAULT_FP_INDEX, DEFAULT_SEED
+    )
+    mask_all = jnp.ones(chunk, bool)
+
+    def b_expand(c):
+        ex = expand_fn(c, mask_all)
+        return c ^ ex.lo[:chunk, None].astype(jnp.int32)
+
+    _, t_expand = fused_time("expand stage (seam)", b_expand, batch,
+                             floor_s)
+    t_commit = max(per - t_expand, 0.0)
+    print(f"{'commit stage (real step - expand)':40s} "
+          f"{t_commit * 1e3:9.3f} ms/iter")
+
+    # pipelined engine at the same geometry, warmed identically: the
+    # per-step delta over the fused engine is the realized overlap;
+    # min(expand, commit) is the two-stage ceiling
+    pinit, _, pstep = make_engine(
+        cfg, chunk=chunk, queue_capacity=qcap,
+        fp_capacity=1 << args.fpcap, pipeline=True, donate=False,
+    )
+    pcarry = pinit()
+    for _ in range(args.steps):
+        pcarry = pstep(pcarry)
+    pcarry = jax.block_until_ready(pcarry)
+    jax.block_until_ready(pstep(pcarry))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        c2 = pcarry
+        for _ in range(K):
+            c2 = pstep(c2)
+        jax.block_until_ready(c2)
+        best = min(best, time.perf_counter() - t0)
+    per_pipe = best / K - floor_s
+    print(f"{'PIPELINED step_fn (x16, floor-adjusted)':40s} "
+          f"{per_pipe * 1e3:9.3f} ms/iter")
+    ceiling = min(t_expand, t_commit)
+    saved = per - per_pipe
+    eff = saved / ceiling if ceiling > 0 else 0.0
+    print(f"overlap efficiency: {eff:6.2f} "
+          f"(saved {saved * 1e3:.3f} ms of {ceiling * 1e3:.3f} ms "
+          f"overlappable per step)")
 
 
 if __name__ == "__main__":
