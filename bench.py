@@ -33,6 +33,10 @@ Usage:
                                # -no-pipeline in one invocation: both
                                # rates + a step_overlap_ms metric line,
                                # full-signature bit-equality gated
+    python bench.py --obs-ab   # Model_1 with the observability counter
+                               # ring on vs off: obs_overhead_pct metric
+                               # line, full-signature bit-equality gated
+                               # (the <= 2% acceptance gate of ISSUE 5)
 """
 
 import json
@@ -55,16 +59,24 @@ def _emit(payload: dict) -> None:
 
     Every payload records the engine pipeline setting (ISSUE 4: the A/B
     harness and history need to know which step schedule produced a
-    number); modes that run both put their setting in explicitly."""
-    base = {
-        "metric": "distinct_states_per_s",
-        "value": 0,
-        "unit": "states/s",
-        "vs_baseline": 0,
-        "pipeline": False,
-    }
-    base.update(payload)
-    print(json.dumps(base), flush=True)
+    number); modes that run both put their setting in explicitly.
+
+    Payload assembly is a derived view of the run journal (ISSUE 5):
+    obs.views.bench_payload stamps every line through an in-memory
+    journal as a schema-validated `bench_metric` event, so the required
+    metric/unit/vs_baseline fields are enforced at emit time - a drifted
+    payload is a crash here, not a hole in BENCH history."""
+    from jaxtlc.obs.views import bench_payload
+
+    print(json.dumps(bench_payload(payload, journal=_JOURNAL)),
+          flush=True)
+
+
+# the bench process's in-memory journal: every emitted payload is also a
+# validated bench_metric event (tests read _JOURNAL.events)
+from jaxtlc.obs.journal import RunJournal  # noqa: E402
+
+_JOURNAL = RunJournal()
 
 
 def _probe_backend(attempts: int = 2, hang_timeout_s: int = 120) -> str:
@@ -432,9 +444,121 @@ def bench_pipeline_ab(probe_err: str) -> int:
     return 0
 
 
+def bench_obs_ab(probe_err: str) -> int:
+    """--obs-ab: measure the cost of the observability plane.
+
+    Runs the full-signature-gated workload twice through the AOT engine
+    - the device counter ring ON (CLI default: 256 slots) and OFF - on
+    whatever device is up (Model_1 on the TPU; the FF corner on the CPU
+    fallback keeps the driver budget).  The obs-on run must be
+    BIT-FOR-BIT identical to obs-off (the ring feeds no control flow);
+    emits an `obs_overhead_pct` metric line (acceptance: <= 2% on the
+    CPU benchmark) plus the standard rate line for the obs-on engine.
+    Both engines are AOT-compiled ONCE and the timed runs interleave
+    (off/on per repeat, best-of-5): single-digit-percent CPU timer
+    drift otherwise dominates the effect being measured."""
+    device_note = ""
+    if probe_err:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        device_note = f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
+    import jax
+
+    from jaxtlc.config import MODEL_1
+    from jaxtlc.engine.bfs import make_engine, result_from_carry
+
+    workload = "Model_1"
+    kw = dict(chunk=1024, queue_capacity=1 << 15, fp_capacity=1 << 20)
+    compiled = {}
+    for slots in (0, 256):
+        init_fn, run_fn, _ = make_engine(
+            MODEL_1, **kw, obs_slots=slots, donate=False,
+        )
+        carry0 = init_fn()
+        compiled[slots] = (run_fn.lower(carry0).compile(), carry0)
+
+    walls = {0: [], 256: []}
+    finals = {}
+    for _ in range(5):
+        for slots in (0, 256):
+            fn, carry0 = compiled[slots]
+            t0 = time.time()
+            out = jax.block_until_ready(fn(carry0))
+            walls[slots].append(time.time() - t0)
+            finals[slots] = out
+
+    import numpy as np
+
+    results = {}
+    for slots, out in finals.items():
+        r = result_from_carry(out, min(walls[slots]),
+                              fp_capacity=kw["fp_capacity"])
+        if r.violation or (
+            r.generated, r.distinct, r.depth
+        ) != EXPECT[workload]:
+            _emit({"error": f"obs_slots={slots} count mismatch: "
+                            f"{(r.generated, r.distinct, r.depth)}",
+                   "workload": workload})
+            return 1
+        results[slots] = r
+
+    def signature(r):
+        return (r.generated, r.distinct, r.depth, r.violation,
+                tuple(sorted(r.action_generated.items())),
+                tuple(sorted(r.action_distinct.items())),
+                r.outdegree, r.fp_occupancy)
+
+    # the full signature AND the fingerprint-table words must match:
+    # the ring is telemetry, not a participant
+    if signature(results[0]) != signature(results[256]) or not (
+        np.asarray(finals[0].fps.table)
+        == np.asarray(finals[256].fps.table)
+    ).all():
+        _emit({"error": "obs-on run is not bit-identical to the obs-off "
+                        "engine", "workload": workload})
+        return 1
+
+    wall_off, wall_on = min(walls[0]), min(walls[256])
+    overhead_pct = 100.0 * (wall_on - wall_off) / wall_off
+    device = str(jax.devices()[0]) + device_note
+    _emit(
+        {
+            "metric": "obs_overhead_pct",
+            "value": round(overhead_pct, 3),
+            "unit": "%",
+            "workload": workload,
+            "obs_slots": 256,
+            "wall_s_obs": round(wall_on, 3),
+            "wall_s_no_obs": round(wall_off, 3),
+            "rate_obs": round(results[256].distinct / wall_on, 1),
+            "rate_no_obs": round(results[0].distinct / wall_off, 1),
+            "repeats": 5,
+            "device": device,
+        }
+    )
+    rate = results[256].distinct / wall_on
+    _emit(
+        {
+            "value": round(rate, 1),
+            "vs_baseline": round(rate / TLC_DISTINCT_PER_S, 2),
+            "workload": workload,
+            "generated": results[256].generated,
+            "distinct": results[256].distinct,
+            "depth": results[256].depth,
+            "wall_s": round(wall_on, 3),
+            "obs_slots": 256,
+            "device": device,
+        }
+    )
+    return 0
+
+
 def main() -> int:
     device_note = ""
     probe_err = _probe_backend()
+    if "--obs-ab" in sys.argv:
+        return bench_obs_ab(probe_err)
     if "--pipeline-ab" in sys.argv:
         return bench_pipeline_ab(probe_err)
     if "--liveness" in sys.argv:

@@ -86,17 +86,30 @@ def _run_check(args) -> int:
     log.starting()
     log.computing_init()
 
+    _open_journal(
+        args, workload=spec.spec_name,
+        engine=("hybrid" if args.fpset == "DiskFPSet"
+                else "sharded" if args.sharded else "single"),
+        device=device,
+        params=dict(chunk=args.chunk, queue_capacity=args.qcap,
+                    fp_capacity=args.fpcap, sharded=args.sharded,
+                    pipeline=args.pipeline,
+                    obs_slots=_obs_slots(args)),
+    )
     t0 = time.time()
     from .resil import SlotOverflowError
 
     sup = None  # SupervisedResult when the resil supervisor ran
     try:
-        r, sup = _dispatch_check(args, spec, log)
+        with _xprof(args):
+            r, sup = _dispatch_check(args, spec, log)
     except SlotOverflowError as e:
         log.msg(1000, f"Run stopped: {e}", severity=1)
+        _finish_journal(args, log)
         return 1
     except FileNotFoundError as e:
         print(f"Error: {e}", file=sys.stderr)
+        _finish_journal(args, log)
         return 1
     log.init_done(2 ** spec.model.n_reconcilers)
 
@@ -107,6 +120,7 @@ def _run_check(args) -> int:
 
         log.progress(r.depth, r.generated, r.distinct, r.queue_left)
         log.final_counts(r.generated, r.distinct, r.queue_left)
+        _finish_journal(args, log, r=None, sup=sup)
         return EXIT_INTERRUPTED
 
     from .engine.bfs import (
@@ -210,9 +224,27 @@ def _run_check(args) -> int:
     if r.outdegree is not None:
         log.outdegree(*r.outdegree)
     log.finished(int((time.time() - t0) * 1000))
+    _finish_journal(
+        args, log, r=r, sup=sup,
+        verdict="liveness_violation" if liveness_violated else None,
+        wall_s=time.time() - t0,
+    )
     if violated:
         return 12
     return 13 if liveness_violated else 0  # TLC liveness exit convention
+
+
+def _xprof(args):
+    """jax.profiler trace context for `-xprof DIR` (the ground-truth
+    device timeline; the journal's -trace-out is the cheap host view).
+    A no-op context when the flag is off."""
+    import contextlib
+
+    if not args.xprof:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(args.xprof)
 
 
 def _dispatch_check(args, spec, log):
@@ -244,6 +276,7 @@ def _dispatch_check(args, spec, log):
                 fp_capacity=args.fpcap,
                 route_factor=args.routefactor,
                 pipeline=args.pipeline,
+                obs_slots=_obs_slots(args),
                 opts=_sup_opts(args, log),
             )
             return sup.result, sup
@@ -255,6 +288,7 @@ def _dispatch_check(args, spec, log):
             fp_capacity=args.fpcap,
             route_factor=args.routefactor,
             pipeline=args.pipeline,
+            obs_slots=_obs_slots(args),
         ), None
     if args.fpset == "DiskFPSet":
         # the OffHeapDiskFPSet/DiskStateQueue analog: authoritative dedup +
@@ -289,6 +323,7 @@ def _dispatch_check(args, spec, log):
             fp_capacity=args.fpcap,
             fp_index=spec.fp_index,
             pipeline=args.pipeline,
+            obs_slots=_obs_slots(args),
             opts=_sup_opts(args, log),
         )
         return sup.result, sup
@@ -301,51 +336,34 @@ def _dispatch_check(args, spec, log):
         fp_capacity=args.fpcap,
         fp_index=spec.fp_index,
         pipeline=args.pipeline,
+        obs_slots=_obs_slots(args),
     ), None
 
 
 def _sup_opts(args, log):
-    """SupervisorOptions from the CLI flags, with supervisor events
-    rendered as TLC-style banners."""
+    """SupervisorOptions from the CLI flags.  Every supervisor event is
+    written to the run journal FIRST (the single source of truth), then
+    the TLC-style banner is rendered as a derived view of that journal
+    event (obs.views.render_tlc_event) - the 2200 Progress line and the
+    checkpoint/recovery/regrow banners cannot drift from what the
+    journal records."""
+    from .obs.views import render_tlc_event
     from .resil import FaultPlan, SupervisorOptions
 
+    journal = getattr(args, "_journal", None)
+    resume_cmd = _resume_command(args)
+
     def on_event(kind, info):
-        if kind == "checkpoint":
-            log.checkpoint_saved(info["path"])
-        elif kind == "recovery":
-            log.recovery(info["path"], info["distinct"])
-        elif kind == "regrow":
-            log.regrow(info["resource"], info["old"], info["new"],
-                       info["violation"])
-        elif kind == "progress":
-            log.progress(info["depth"], info["generated"],
-                         info["distinct"], info["queue"])
-        elif kind == "retry":
-            log.msg(
-                1000,
-                f"Transient error (attempt {info['attempt']}): "
-                f"{info['error']}; retrying in {info['delay_s']}s from "
-                "the last good state.",
-                severity=1,
-            )
-        elif kind == "ckpt_write_failed":
-            log.msg(
-                1000,
-                f"Checkpoint write failed: {info['error']} (run "
-                "continues; the next segment boundary retries).",
-                severity=1,
-            )
-        elif kind == "ckpt_fallback":
-            log.msg(
-                1000,
-                f"Checkpoint {info['path']} failed verification "
-                f"({info['error']}); falling back to the previous "
-                "generation.",
-                severity=1,
-            )
-        elif kind == "interrupted":
-            log.interrupted(info["signum"], info["path"],
-                            _resume_command(args))
+        if journal is not None:
+            ev = journal.event(kind, **info)
+        else:
+            import time as _time
+
+            from .obs.schema import SCHEMA_VERSION
+
+            ev = {"v": SCHEMA_VERSION, "t": _time.time(),
+                  "event": kind, **info}
+        render_tlc_event(log, ev, resume_cmd=resume_cmd)
 
     return SupervisorOptions(
         auto_grow=args.autogrow,
@@ -357,6 +375,76 @@ def _sup_opts(args, log):
         faults=FaultPlan.parse(args.faults) if args.faults else None,
         on_event=on_event,
     )
+
+
+def _obs_slots(args) -> int:
+    """Counter-ring depth in effect: -no-obs disables the device tier
+    entirely (the A/B baseline; also the shape pre-obs checkpoints
+    expect), otherwise -obs-slots levels of history ride the carry."""
+    return args.obsslots if args.obs else 0
+
+
+def _open_journal(args, workload: str, engine: str, device: str,
+                  params: dict):
+    """Create the run journal and stamp the manifest.
+
+    Path resolution: -journal PATH wins; else a -checkpoint run
+    journals beside its snapshots (PATH.journal.jsonl) so preemption
+    and -recover find it; else the journal is in-memory only (still
+    powers -trace-out).  A -recover run APPENDS and stamps run_resume:
+    one continuous journal per logical run, not one per attempt."""
+    from . import __version__ as _v
+    from .obs.journal import RunJournal
+
+    path = args.journal or (
+        args.checkpoint + ".journal.jsonl" if args.checkpoint else ""
+    )
+    resume = bool(args.recover and path and os.path.exists(path))
+    j = RunJournal(path or None, resume=resume)
+    if resume:
+        j.event("run_resume", version=_v, path=path)
+    else:
+        j.event("run_start", version=_v, workload=workload,
+                engine=engine, device=device, params=params)
+    args._journal = j
+    return j
+
+
+def _finish_journal(args, log, r=None, sup=None, verdict: str = None,
+                    wall_s: float = 0.0) -> None:
+    """Close out the journal: the final event (when the supervisor did
+    not already emit one), the violation record, and the -trace-out
+    export (reading the WHOLE journal file so a resumed run's trace
+    covers both attempts)."""
+    j = getattr(args, "_journal", None)
+    if j is None:
+        return
+    try:
+        if r is not None and r.violation != 0:
+            j.event("violation", code=int(r.violation),
+                    name=r.violation_name)
+        if verdict == "liveness_violation":
+            j.event("violation", code=13,
+                    name="Temporal properties were violated")
+        if sup is None and r is not None:
+            v = verdict or ("violation" if r.violation != 0 else "ok")
+            j.event("final", verdict=v, generated=r.generated,
+                    distinct=r.distinct, depth=r.depth,
+                    queue=r.queue_left, wall_s=round(wall_s, 6),
+                    interrupted=False)
+        if args.traceout:
+            from .obs.journal import read as read_journal
+            from .obs.trace import export_chrome_trace
+
+            events = read_journal(j.path, validate=False) if j.path \
+                else j.events
+            n = export_chrome_trace(events, args.traceout)
+            j.event("trace_export", path=args.traceout, events=n)
+            log.msg(1000, f"Timeline trace written to {args.traceout} "
+                          f"({n} events; open in ui.perfetto.dev).")
+    finally:
+        j.close()
+        args._journal = None
 
 
 def _resume_command(args) -> str:
@@ -472,6 +560,7 @@ def _run_check_gen(args, spec) -> int:
             route_factor=args.routefactor,
             backend=backend,
             pipeline=args.pipeline,
+            obs_slots=_obs_slots(args),
         )
         if args.checkpoint:
             meta_config = {
@@ -586,12 +675,14 @@ def _run_check_struct(args, spec) -> int:
                     meta_config=struct_meta_config(sm),
                     route_factor=args.routefactor,
                     pipeline=args.pipeline,
+                    obs_slots=_obs_slots(args),
                     opts=_sup_opts(args, log), **kw,
                 )
                 return sup.result, sup
             return check_struct_sharded(
                 sm, mesh, route_factor=args.routefactor,
-                check_deadlock=ckd, pipeline=args.pipeline, **kw,
+                check_deadlock=ckd, pipeline=args.pipeline,
+                obs_slots=_obs_slots(args), **kw,
             ), None
         if args.checkpoint or args.autogrow:
             from .resil import check_supervised
@@ -601,12 +692,13 @@ def _run_check_struct(args, spec) -> int:
                 backend=get_backend(sm, ckd),
                 meta_config=struct_meta_config(sm), check_deadlock=ckd,
                 pipeline=args.pipeline,
+                obs_slots=_obs_slots(args),
                 opts=_sup_opts(args, log), **kw,
             )
             return sup.result, sup
         return check_struct(
             sm, fp_index=spec.fp_index, check_deadlock=ckd,
-            pipeline=args.pipeline, **kw,
+            pipeline=args.pipeline, obs_slots=_obs_slots(args), **kw,
         ), None
 
     def props():
@@ -704,16 +796,28 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
     log.sany(*_sany_inputs(args.config, spec.spec_name))
     log.starting()
     log.computing_init()
+    _open_journal(
+        args, workload=spec.spec_name,
+        engine="sharded" if args.sharded else "single",
+        device=device,
+        params=dict(chunk=args.chunk, queue_capacity=args.qcap,
+                    fp_capacity=args.fpcap, sharded=args.sharded,
+                    pipeline=args.pipeline, frontend=kit.kind,
+                    obs_slots=_obs_slots(args)),
+    )
     t0 = time.time()
     from .resil import SlotOverflowError
 
     try:
-        r, sup = kit.check()
+        with _xprof(args):
+            r, sup = kit.check()
     except SlotOverflowError as e:
         log.msg(1000, f"Run stopped: {e}", severity=1)
+        _finish_journal(args, log)
         return 1
     except FileNotFoundError as e:
         print(f"Error: {e}", file=sys.stderr)
+        _finish_journal(args, log)
         return 1
     n_init = kit.init_count()
     log.init_done(n_init)
@@ -724,6 +828,7 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
 
         log.progress(r.depth, r.generated, r.distinct, r.queue_left)
         log.final_counts(r.generated, r.distinct, r.queue_left)
+        _finish_journal(args, log, r=None, sup=sup)
         return EXIT_INTERRUPTED
     violated = r.violation != 0
     liveness_violated = False
@@ -824,6 +929,11 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
     log.final_counts(r.generated, r.distinct, r.queue_left)
     log.depth(r.depth)
     log.finished(int((time.time() - t0) * 1000))
+    _finish_journal(
+        args, log, r=r, sup=sup,
+        verdict="liveness_violation" if liveness_violated else None,
+        wall_s=time.time() - t0,
+    )
     if violated:
         return 12
     return 13 if liveness_violated else 0
@@ -950,6 +1060,40 @@ def main(argv=None) -> int:
                    action="store_true",
                    help="disable the persistent compile cache for this "
                         "run")
+    c.add_argument("-obs", dest="obs", action="store_true", default=True,
+                   help="(default) carry the on-device observability "
+                        "counter ring: one per-level telemetry row "
+                        "(generated/distinct/queue/occupancy/per-action "
+                        "counts), read back at segment fences and "
+                        "journaled as `level` events.  Pure telemetry: "
+                        "results are bit-for-bit identical to -no-obs "
+                        "(bench.py --obs-ab gates overhead at <= 2%)")
+    c.add_argument("-no-obs", dest="obs", action="store_false",
+                   help="disable the device counter ring (also the "
+                        "carry shape pre-obs checkpoints expect)")
+    c.add_argument("-obs-slots", dest="obsslots", type=int, default=256,
+                   metavar="N",
+                   help="counter-ring depth: per-level rows retained on "
+                        "device between fences (wrap loses per-level "
+                        "resolution, never totals - rows are cumulative)")
+    c.add_argument("-journal", default="", metavar="PATH",
+                   help="append-only JSONL run journal (fsync'd per "
+                        "event, schema-versioned: obs/schema.py).  "
+                        "Defaults to CHECKPOINT.journal.jsonl when "
+                        "-checkpoint is set; -recover APPENDS, so an "
+                        "interrupted+resumed run has ONE journal.  "
+                        "tools/tlcstat.py tails it live")
+    c.add_argument("-trace-out", dest="traceout", default="",
+                   metavar="FILE",
+                   help="export the run timeline as a Chrome-trace JSON "
+                        "(open in ui.perfetto.dev): segment slices, "
+                        "per-level expand/commit lanes, checkpoint "
+                        "writes, regrow/retry/interrupt markers, "
+                        "counter tracks")
+    c.add_argument("-xprof", default="", metavar="DIR",
+                   help="wrap the check in a jax.profiler trace writing "
+                        "to DIR (the ground-truth device timeline; "
+                        "view with TensorBoard/XProf)")
     c.add_argument("-coverage", action="store_true",
                    help="emit the full per-expression coverage dump "
                         "(TLC coverage mode; re-walks the space host-side)")

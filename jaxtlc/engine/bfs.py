@@ -113,6 +113,16 @@ class EngineCarry(NamedTuple):
     st_viol: jnp.ndarray = None  # int32 expand-stage violation code
     st_viol_state: jnp.ndarray = None  # [F] int32
     st_viol_action: jnp.ndarray = None  # int32
+    # --- observability counter ring (None when obs is off) ------------
+    # One row per completed BFS level (obs.counters layout), written
+    # with a single contiguous row store per body (non-flip bodies hit
+    # the dump row), read back at segment fences.  None leaves vanish
+    # from the pytree, so obs-off carries keep the pre-obs checkpoint
+    # layout bit-for-bit.
+    obs_ring: jnp.ndarray = None  # [obs_slots + 1, cols] uint32
+    obs_head: jnp.ndarray = None  # int32 level rows ever written
+    obs_bodies: jnp.ndarray = None  # uint32 loop bodies executed
+    obs_expanded: jnp.ndarray = None  # uint32 states popped so far
 
 
 class CheckResult(NamedTuple):
@@ -166,6 +176,7 @@ def make_engine(
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
     pipeline: bool = False,
     donate: bool = True,
+    obs_slots: int = 0,
 ):
     """Build (init_fn, run_fn, step_fn) for one KubeAPI configuration.
 
@@ -177,7 +188,7 @@ def make_engine(
     return make_backend_engine(
         kubeapi_backend(cfg), chunk, queue_capacity, fp_capacity,
         fp_index, seed, fp_highwater=fp_highwater, pipeline=pipeline,
-        donate=donate,
+        donate=donate, obs_slots=obs_slots,
     )
 
 
@@ -192,6 +203,7 @@ def make_backend_engine(
     check_deadlock: bool = None,
     pipeline: bool = False,
     donate: bool = True,
+    obs_slots: int = 0,
 ):
     """Build (init_fn, run_fn, step_fn) over any SpecBackend.
 
@@ -233,7 +245,16 @@ def make_backend_engine(
     queue/candidate buffers across iterations instead of copying.  Pass
     donate=False when the SAME carry value is fed to the engine twice
     (profilers, the resil supervisor's retry-from-last-good loop).
+
+    obs_slots > 0 carries the observability counter ring (obs.counters):
+    one cumulative-counter row per completed BFS level, written with a
+    single contiguous row store per body (the dump-row trick makes the
+    write unconditional).  The ring is pure telemetry - it feeds no
+    control flow and no arbitration - so check results with obs on are
+    bit-for-bit those of an obs-off run (bench.py --obs-ab gates the
+    wall-clock overhead at <= 2%).
     """
+    from ..obs.counters import pack_row, ring_new, ring_update
     from .backend import ExpandOut, make_expand_stage
 
     assert 0.0 < fp_highwater <= 1.0, "fp_highwater must be in (0, 1]"
@@ -299,6 +320,13 @@ def make_backend_engine(
                 st_viol_state=jnp.zeros(F, jnp.int32),
                 st_viol_action=jnp.int32(-1),
             )
+        obs = {}
+        if obs_slots:
+            ring, head = ring_new(obs_slots, n_labels)
+            obs = dict(
+                obs_ring=ring, obs_head=head,
+                obs_bodies=jnp.uint32(0), obs_expanded=jnp.uint32(0),
+            )
         return EngineCarry(
             fps=fps,
             queue=queue,
@@ -317,6 +345,7 @@ def make_backend_engine(
             viol_state=viol_state,
             viol_action=jnp.int32(-1),
             **staged,
+            **obs,
         )
 
     def make_stages(ck: int):
@@ -493,6 +522,25 @@ def make_backend_engine(
             level = jnp.where(advance, c.level + 1, c.level)
             depth = jnp.maximum(c.depth, level)
 
+            obs = {}
+            if obs_slots:
+                # one telemetry row per completed level (post-commit
+                # cumulative counters; the dump row absorbs non-flip
+                # bodies so the store is unconditional)
+                obs_bodies = c.obs_bodies + jnp.uint32(1)
+                obs_expanded = c.obs_expanded + n.astype(jnp.uint32)
+                row = pack_row(
+                    c.level, generated, distinct, level_n, obs_bodies,
+                    obs_expanded, act_gen[:n_labels],
+                    act_dist[:n_labels],
+                )
+                ring, head = ring_update(
+                    c.obs_ring, c.obs_head, row, level_done
+                )
+                obs = dict(obs_ring=ring, obs_head=head,
+                           obs_bodies=obs_bodies,
+                           obs_expanded=obs_expanded)
+
             return c._replace(
                 fps=fps,
                 queue=queue,
@@ -510,6 +558,7 @@ def make_backend_engine(
                 viol=viol,
                 viol_state=viol_state,
                 viol_action=viol_action,
+                **obs,
             )
 
         return pop_expand, commit
@@ -615,6 +664,7 @@ def check(
     seed: int = DEFAULT_SEED,
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
     pipeline: bool = False,
+    obs_slots: int = 0,
 ) -> CheckResult:
     """Run an exhaustive check; the single-device engine entry point.
 
@@ -624,7 +674,7 @@ def check(
     way)."""
     init_fn, run_fn, _ = make_engine(
         cfg, chunk, queue_capacity, fp_capacity, fp_index, seed,
-        fp_highwater=fp_highwater, pipeline=pipeline,
+        fp_highwater=fp_highwater, pipeline=pipeline, obs_slots=obs_slots,
     )
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()
@@ -636,6 +686,25 @@ def check(
     afc = float(fpset_actual_collision(carry.fps))
     return result_from_carry(carry, wall, fp_capacity=fp_capacity)._replace(
         actual_fp_collision=afc
+    )
+
+
+def obs_rows(carry, labels: tuple = None, since: int = 0,
+             fp_capacity: int = 0):
+    """Decode the carry's observability ring into journal-`level`-event
+    dicts (oldest first) plus the new head cursor.  ([], since) when obs
+    is off - callers need no obs-awareness of their own."""
+    from ..obs.counters import rows_from_ring
+
+    if getattr(carry, "obs_ring", None) is None:
+        return [], int(since)
+    head = int(carry.obs_head)
+    return (
+        rows_from_ring(
+            np.asarray(carry.obs_ring), head, labels=labels,
+            since=since, fp_capacity=fp_capacity,
+        ),
+        head,
     )
 
 
@@ -652,6 +721,11 @@ class EnumCarry(NamedTuple):
     head: jnp.ndarray  # int32: next id to expand
     tail: jnp.ndarray  # int32: number of distinct states stored
     viol: jnp.ndarray  # int32: OK or a capacity/overflow code
+    # observability ring (None when obs is off): the enumerator is
+    # level-less, so one row per BODY (ring wraps; cumulative counters
+    # keep totals exact) - queue col = unexpanded backlog
+    obs_ring: jnp.ndarray = None  # [obs_slots + 1, cols] uint32
+    obs_head: jnp.ndarray = None  # int32 rows ever written
 
 
 def make_enumerator(
@@ -662,6 +736,7 @@ def make_enumerator(
     fp_index: int = DEFAULT_FP_INDEX,
     seed: int = DEFAULT_SEED,
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
+    obs_slots: int = 0,
 ):
     """Build (init_fn, run_fn) for the fused distinct-state enumerator.
 
@@ -679,11 +754,14 @@ def make_enumerator(
     (the caller's cue to raise it or spill), VIOL_FPSET_FULL /
     VIOL_SLOT_OVERFLOW as in the exhaustive engine.
     """
+    from ..obs.counters import pack_row, ring_new, ring_update
+
     cdc = backend.cdc
     F = cdc.n_fields
     W = (cdc.nbits + 31) // 32
     step = backend.step
     L = backend.n_lanes
+    n_labels = len(backend.labels)
     nbits = cdc.nbits
     cap = state_capacity
     ncand = chunk * L
@@ -700,12 +778,17 @@ def make_enumerator(
         fps, _, _, _ = fpset_insert_sorted(
             fpset_new(fp_capacity), lo, hi, jnp.ones(n0, bool)
         )
+        obs = {}
+        if obs_slots:
+            ring, rhead = ring_new(obs_slots, n_labels)
+            obs = dict(obs_ring=ring, obs_head=rhead)
         return EnumCarry(
             fps=fps,
             states=states,
             head=jnp.int32(0),
             tail=jnp.int32(n0),
             viol=jnp.int32(OK),
+            **obs,
         )
 
     def body(c: EnumCarry) -> EnumCarry:
@@ -771,8 +854,22 @@ def make_enumerator(
         )
         viol = jnp.where(s_full & (viol == OK), VIOL_QUEUE_FULL, viol)
         tail = jnp.where(s_full, c.tail, c.tail + n_new)
+        obs = {}
+        if obs_slots:
+            # one row per body (the enumerator has no levels): distinct
+            # doubles as generated-distinct, queue = unexpanded backlog
+            zeros = jnp.zeros(n_labels, jnp.uint32)
+            row = pack_row(
+                jnp.int32(0), tail, tail, tail - (c.head + n),
+                c.obs_head + 1, c.head + n, zeros, zeros,
+            )
+            ring, rhead = ring_update(
+                c.obs_ring, c.obs_head, row, jnp.bool_(True)
+            )
+            obs = dict(obs_ring=ring, obs_head=rhead)
         return EnumCarry(
-            fps=fps, states=states, head=c.head + n, tail=tail, viol=viol
+            fps=fps, states=states, head=c.head + n, tail=tail,
+            viol=viol, **obs,
         )
 
     def cond(c: EnumCarry):

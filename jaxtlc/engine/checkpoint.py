@@ -250,6 +250,7 @@ def check_with_checkpoints(
     on_progress=None,
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
     pipeline: bool = False,
+    obs_slots: int = 0,
 ) -> CheckResult:
     """Exhaustive check with periodic checkpoints every `ckpt_every` chunks.
 
@@ -273,6 +274,7 @@ def check_with_checkpoints(
     init_fn, _, step_fn = make_engine(
         cfg, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater=fp_highwater, pipeline=pipeline, donate=False,
+        obs_slots=obs_slots,
     )
     meta = _meta(
         cfg,
@@ -283,6 +285,7 @@ def check_with_checkpoints(
         seed=seed,
         fp_highwater=fp_highwater,
         pipeline=pipeline,
+        obs_slots=obs_slots,
     )
 
     @jax.jit
@@ -302,10 +305,11 @@ def check_with_checkpoints(
         # across a resume)
         for key in ("format", "config", "chunk", "queue_capacity",
                     "fp_capacity", "fp_index", "seed", "fp_highwater",
-                    "pipeline"):
-            # pre-pipeline snapshots carry no key: treat as False
-            saved = saved_meta.get(key, False if key == "pipeline"
-                                    else None)
+                    "pipeline", "obs_slots"):
+            # pre-pipeline/pre-obs snapshots carry no key: treat as off
+            saved = saved_meta.get(
+                key, False if key == "pipeline"
+                else 0 if key == "obs_slots" else None)
             if saved != meta[key]:
                 raise ValueError(
                     f"checkpoint {key} mismatch: "

@@ -46,7 +46,12 @@ def host_bfs(
     keep_parents: bool = False,
     stop_on_violation: bool = True,
     check_deadlock: bool = True,
+    journal=None,
 ) -> HostBFSResult:
+    """`journal` (an obs.journal.RunJournal) receives one `level` event
+    per BFS level - the host driver reports through the same telemetry
+    plane as the device engines, so a trace-mode re-run is just as
+    observable as the run it is explaining."""
     cdc = get_codec(cfg)
     kern = batched_kernel(cfg)
     inv_kern = batched_invariants(cfg)
@@ -69,6 +74,7 @@ def host_bfs(
     levels = [len(frontier)]
     max_out, min_out = 0, 1 << 30
     action_generated: Dict[int, int] = {}
+    bodies = expanded = 0
 
     pad_template = np.zeros((chunk, F), dtype=np.int32)
 
@@ -82,6 +88,13 @@ def host_bfs(
     while frontier:
         if on_level is not None:
             on_level(depth, frontier)
+        if journal is not None:
+            journal.event(
+                "level", level=depth, generated=generated,
+                distinct=len(seen), queue=len(frontier),
+                bodies=bodies, expanded=expanded,
+            )
+        expanded += len(frontier)
         nxt: List[np.ndarray] = []
         # chunk-level software pipeline: chunk i+1's kernel is dispatched
         # BEFORE chunk i's results are pulled to host, so the Python
@@ -97,6 +110,7 @@ def host_bfs(
             buf[:n] = np.stack(batch)
             chunks.append((buf, n))
         in_flight = dispatch(chunks[0][0]) if chunks else None
+        bodies += len(chunks)
         for i, (buf, n) in enumerate(chunks):
             current = in_flight
             in_flight = (

@@ -114,6 +114,14 @@ class ShardCarry(NamedTuple):
     pv_order: jnp.ndarray = None  # [D, ncand] int32 owner-sort permutation
     pv_faction: jnp.ndarray = None  # [D, ncand] int32 candidate action ids
     pv_n: jnp.ndarray = None  # [D] int32 popped rows of the pending chunk
+    # --- observability counter ring (None when obs is off) ------------
+    # Per-device partial-counter rows, one per GLOBAL level flip (level
+    # fencing is a psum, so every device writes row k for the same
+    # level; obs.counters.shard_rows_from_ring sums the partials).
+    obs_ring: jnp.ndarray = None  # [D, obs_slots + 1, cols] uint32
+    obs_head: jnp.ndarray = None  # [D] int32 rows ever written
+    obs_bodies: jnp.ndarray = None  # [D] uint32 loop bodies
+    obs_expanded: jnp.ndarray = None  # [D] uint32 states popped
 
 
 def route_bucket_width(chunk: int, n_lanes: int, D: int,
@@ -140,6 +148,7 @@ def make_sharded_engine(
     backend: SpecBackend = None,
     fp_highwater: float = None,
     pipeline: bool = False,
+    obs_slots: int = 0,
 ):
     """Build (init_fn, run_fn) over `mesh` (single axis named "fp").
 
@@ -165,7 +174,16 @@ def make_sharded_engine(
     per-action distinct - never control flow), so final counts are
     bit-for-bit those of the unpipelined engine; the loop runs one
     extra drain iteration at the end to apply the last chunk's stats.
+
+    obs_slots > 0 carries the per-device observability counter ring
+    (obs.counters): one partial-counter row per global level flip,
+    summed host-side.  Pure telemetry - no control flow reads it - so
+    results with obs on are bit-for-bit those of an obs-off run.  In
+    pipeline mode the per-level act_dist/outdegree attribution lags one
+    chunk (the deferred verdict exchange); cumulative totals catch up
+    at the next row.
     """
+    from ..obs.counters import pack_row, ring_cols, ring_update
     (axis,) = mesh.axis_names
     D = mesh.devices.size
     assert D & (D - 1) == 0, "device count must be a power of two"
@@ -227,6 +245,16 @@ def make_sharded_engine(
                 pv_faction=jnp.zeros((D, ncand), jnp.int32),
                 pv_n=jnp.zeros(D, jnp.int32),
             )
+        obs = {}
+        if obs_slots:
+            obs = dict(
+                obs_ring=jnp.zeros(
+                    (D, obs_slots + 1, ring_cols(n_labels)), jnp.uint32
+                ),
+                obs_head=jnp.zeros(D, jnp.int32),
+                obs_bodies=jnp.zeros(D, jnp.uint32),
+                obs_expanded=jnp.zeros(D, jnp.uint32),
+            )
         return ShardCarry(
             table=jnp.asarray(table),
             queue=jnp.asarray(queue),
@@ -245,6 +273,7 @@ def make_sharded_engine(
             viol_local=jnp.zeros(D, bool),
             cont=jnp.ones(D, bool),
             **pv,
+            **obs,
         )
 
     # ---------------- per-device loop body --------------------------------
@@ -452,6 +481,25 @@ def make_sharded_engine(
         )
         level_end2 = jnp.where(adv & level_done, qtail, level_end)
         cont = more & (global_viol == OK)
+        obs2 = {}
+        if obs_slots:
+            # one partial-counter row per GLOBAL level flip (level_done
+            # is a psum verdict, so every device's ring stays in
+            # lock-step); non-flip bodies write the dump row
+            obs_bodies = c.obs_bodies[0] + jnp.uint32(1)
+            obs_expanded = c.obs_expanded[0] + n.astype(jnp.uint32)
+            row = pack_row(
+                level, generated, distinct, qtail - qhead, obs_bodies,
+                obs_expanded, act_gen[:n_labels], act_dist[:n_labels],
+            )
+            ring, rhead = ring_update(
+                c.obs_ring[0], c.obs_head[0], row, adv & level_done
+            )
+            obs2 = dict(
+                obs_ring=ring[None], obs_head=rhead[None],
+                obs_bodies=obs_bodies[None],
+                obs_expanded=obs_expanded[None],
+            )
         pv2 = {}
         if pipeline:
             # a popped chunk leaves its verdicts pending: keep the loop
@@ -488,6 +536,7 @@ def make_sharded_engine(
             viol_local=viol_local2[None],
             cont=cont[None],
             **pv2,
+            **obs2,
         )
 
     def device_loop(c: ShardCarry) -> ShardCarry:
@@ -505,6 +554,12 @@ def make_sharded_engine(
             for f in ("pv_send", "pv_sown", "pv_pos", "pv_svalid",
                       "pv_order", "pv_faction", "pv_n")
         }
+    if obs_slots:
+        pv_specs.update({
+            f: P(axis)
+            for f in ("obs_ring", "obs_head", "obs_bodies",
+                      "obs_expanded")
+        })
     specs = ShardCarry(
         table=P(axis),
         queue=P(axis),
@@ -578,6 +633,25 @@ def result_from_shard_carry(
             int(np.asarray(out.distinct).sum()) / fp_capacity_total
             if fp_capacity_total else None
         ),
+    )
+
+
+def obs_rows_sharded(carry: ShardCarry, labels: tuple = None,
+                     since: int = 0, fp_capacity_total: int = 0):
+    """Decode a ShardCarry's observability rings (per-device partials
+    summed per level) into journal-`level`-event dicts + the new head
+    cursor; ([], since) when obs is off."""
+    from ..obs.counters import shard_rows_from_ring
+
+    if getattr(carry, "obs_ring", None) is None:
+        return [], int(since)
+    heads = np.asarray(carry.obs_head)
+    return (
+        shard_rows_from_ring(
+            np.asarray(carry.obs_ring), heads, labels=labels,
+            since=since, fp_capacity_total=fp_capacity_total,
+        ),
+        int(heads.min()),
     )
 
 
@@ -711,6 +785,7 @@ def check_sharded(
     route_factor: float = 2.0,
     backend: SpecBackend = None,
     pipeline: bool = False,
+    obs_slots: int = 0,
 ) -> CheckResult:
     """Exhaustive sharded check; returns globally-reduced statistics.
 
@@ -721,6 +796,7 @@ def check_sharded(
     init_fn, run_fn = make_sharded_engine(
         cfg, mesh, chunk, queue_capacity, fp_capacity,
         route_factor=route_factor, backend=backend, pipeline=pipeline,
+        obs_slots=obs_slots,
     )
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()
@@ -747,6 +823,7 @@ def check_sharded_with_checkpoints(
     backend: SpecBackend = None,
     meta_config: dict = None,
     pipeline: bool = False,
+    obs_slots: int = 0,
 ) -> CheckResult:
     """Sharded check with periodic whole-carry checkpoints (TLC checkpoint
     analog under distribution: one snapshot covers every shard's partition
@@ -761,7 +838,7 @@ def check_sharded_with_checkpoints(
     init_fn, seg_fn = make_sharded_engine(
         cfg, mesh, chunk, queue_capacity, fp_capacity,
         route_factor=route_factor, segment=ckpt_every, backend=backend,
-        pipeline=pipeline,
+        pipeline=pipeline, obs_slots=obs_slots,
     )
     meta = _meta(
         cfg,
@@ -770,6 +847,7 @@ def check_sharded_with_checkpoints(
         fp_capacity=fp_capacity,
         devices=int(mesh.devices.size),
         pipeline=pipeline,
+        obs_slots=obs_slots,
     )
     template = init_fn()
     compiled = seg_fn.lower(template).compile()
@@ -779,11 +857,13 @@ def check_sharded_with_checkpoints(
             raise FileNotFoundError(f"no checkpoint at {ckpt_path!r}")
         saved_meta, carry = load_checkpoint(ckpt_path, template)
         for key in ("format", "config", "queue_capacity", "fp_capacity",
-                    "devices", "pipeline"):
-            # pre-pipeline snapshots carry no key: treat as False so
-            # they resume on the unpipelined engine they were cut from
-            saved = saved_meta.get(key, False if key == "pipeline"
-                                   else None)
+                    "devices", "pipeline", "obs_slots"):
+            # pre-pipeline/pre-obs snapshots carry no key: treat as
+            # off - they were cut from engines without those leaves
+            saved = saved_meta.get(
+                key, False if key == "pipeline"
+                else 0 if key == "obs_slots" else None
+            )
             if saved != meta[key]:
                 raise ValueError(
                     f"checkpoint {key} mismatch: "

@@ -153,21 +153,23 @@ class TLCLog:
         self, depth: int, generated: int, distinct: int, queue: int
     ) -> None:
         """TLC's 2200 Progress line incl. the per-minute rates computed
-        from the previous Progress report (MC.out:35,1095)."""
+        from the stored previous Progress report (MC.out:35,1095).
+
+        The rate arithmetic is obs.views.interval_rates - the SAME
+        function tools/tlcstat.py renders from the journal, so the log
+        line and the dashboard cannot disagree.  First report: TLC
+        prints the raw interval counts as the "per-minute" rates
+        (MC.out:35 shows 538,163 generated in ~4 s reported as
+        "538,163 s/min"), and interval_rates does the same."""
+        from ..obs.views import interval_rates
+
         now = time.time()
         prev = getattr(self, "_prev_progress", None)
         self._prev_progress = (now, generated, distinct)
-        rates = ""
-        if prev is not None and now > prev[0]:
-            dt = now - prev[0]
-            spm = int((generated - prev[1]) * 60 / dt)
-            dpm = int((distinct - prev[2]) * 60 / dt)
-            self._last_rates = (spm, dpm)
-        else:
-            # first report: TLC prints the raw interval counts as the
-            # "per-minute" rates (MC.out:35 shows 538,163 generated in ~4 s
-            # reported as "538,163 s/min"), so we do the same
-            self._last_rates = (generated, distinct)
+        if prev is None or now > prev[0]:
+            self._last_rates = interval_rates(
+                prev, now, generated, distinct
+            )
         spm, dpm = self._last_rates
         self.msg(
             2200,

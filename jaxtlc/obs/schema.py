@@ -1,0 +1,135 @@
+"""Versioned schema of the run journal (the telemetry contract).
+
+Every line of a run journal (obs.journal.RunJournal) is one JSON event
+validated against this module BEFORE it is written, and the tier-1
+golden test re-validates every line of a real run's journal after the
+fact - so event-shape drift is a loud failure in both directions
+(producer and consumer), never a silently-changed dashboard.
+
+The schema is deliberately dependency-free (no jsonschema package in
+the image): each event kind declares its REQUIRED fields with python
+type tuples; extra fields are allowed (views ignore what they don't
+know), missing/badly-typed required fields raise JournalSchemaError.
+
+Bump SCHEMA_VERSION whenever a required field is added, removed, or
+changes meaning; readers (tools/tlcstat.py, obs.trace) check it and
+refuse journals from the future.
+"""
+
+from __future__ import annotations
+
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+_STR = (str,)
+_BOOL = (bool,)
+_OPT_STR = (str, type(None))
+_OPT_NUM = (int, float, type(None))
+
+# common envelope fields stamped by RunJournal.event on every line
+ENVELOPE = {
+    "v": (int,),  # SCHEMA_VERSION of the writer
+    "t": _NUM,  # host wall-clock (epoch seconds) at write time
+    "event": _STR,  # the event kind (a key of EVENTS)
+}
+
+# event kind -> {required field: accepted types}
+EVENTS = {
+    # -- run lifecycle -----------------------------------------------------
+    # the run manifest: first event of a fresh journal
+    "run_start": {"version": _STR, "workload": _STR, "engine": _STR,
+                  "device": _STR, "params": (dict,)},
+    # -recover appended to an existing journal (one continuous history)
+    "run_resume": {"version": _STR, "path": _STR},
+    # one supervised segment fenced: host-observed dispatch/fence times
+    "segment": {"index": _NUM, "t_dispatch": _NUM, "t_fence": _NUM,
+                "wall_s": _NUM},
+    # one BFS level completed (decoded from the device counter ring)
+    "level": {"level": _NUM, "generated": _NUM, "distinct": _NUM,
+              "queue": _NUM, "bodies": _NUM, "expanded": _NUM},
+    # the TLC 2200 Progress-line source (segment-boundary counters)
+    "progress": {"depth": _NUM, "generated": _NUM, "distinct": _NUM,
+                 "queue": _NUM},
+    # -- resilience --------------------------------------------------------
+    "checkpoint": {"path": _STR, "seconds": _NUM, "label": _STR},
+    "ckpt_write_failed": {"error": _STR},
+    "ckpt_fallback": {"path": _STR, "error": _STR},
+    "recovery": {"path": _STR, "depth": _NUM, "generated": _NUM,
+                 "distinct": _NUM, "queue": _NUM},
+    "regrow": {"resource": _STR, "old": _NUM, "new": _NUM,
+               "violation": _STR, "seconds": _NUM},
+    "retry": {"attempt": _NUM, "delay_s": _NUM, "error": _STR},
+    "fault": {"kind": _STR, "at": _NUM},
+    "interrupted": {"signum": _OPT_NUM, "path": _OPT_STR,
+                    "generated": _NUM, "distinct": _NUM, "queue": _NUM,
+                    "wall_s": _NUM},
+    # -- verdicts ----------------------------------------------------------
+    "violation": {"code": _NUM, "name": _STR},
+    # the structured final event: EVERY run (clean, violated, interrupted,
+    # progress-lost) ends its journal with exactly one of these
+    "final": {"verdict": _STR, "generated": _NUM, "distinct": _NUM,
+              "depth": _NUM, "queue": _NUM, "wall_s": _NUM,
+              "interrupted": _BOOL},
+    # -- derived artifacts -------------------------------------------------
+    "trace_export": {"path": _STR, "events": _NUM},
+    # one bench.py metric payload (the BENCH_*.json line contract)
+    "bench_metric": {"metric": _STR, "value": _NUM, "unit": _STR,
+                     "vs_baseline": _NUM},
+}
+
+# the verdict vocabulary of the "final" event
+VERDICTS = ("ok", "violation", "liveness_violation", "interrupted",
+            "error")
+
+
+class JournalSchemaError(ValueError):
+    """A journal event does not satisfy the versioned schema."""
+
+
+def validate_event(ev: dict) -> dict:
+    """Validate one journal event dict; returns it unchanged on success.
+
+    Checks the envelope (v/t/event), that the kind is known, and that
+    every required field of the kind is present with an accepted type.
+    Extra fields pass - views ignore what they don't know."""
+    if not isinstance(ev, dict):
+        raise JournalSchemaError(f"event is not an object: {ev!r}")
+    for field, types in ENVELOPE.items():
+        if field not in ev:
+            raise JournalSchemaError(f"event missing envelope {field!r}: {ev!r}")
+        if not isinstance(ev[field], types) or isinstance(ev[field], bool):
+            # bool is an int subclass; envelope fields are never bools
+            raise JournalSchemaError(
+                f"envelope {field!r} has type {type(ev[field]).__name__}, "
+                f"want one of {[t.__name__ for t in types]}: {ev!r}"
+            )
+    if ev["v"] > SCHEMA_VERSION:
+        raise JournalSchemaError(
+            f"journal schema v{ev['v']} is newer than this reader "
+            f"(v{SCHEMA_VERSION})"
+        )
+    kind = ev["event"]
+    spec = EVENTS.get(kind)
+    if spec is None:
+        raise JournalSchemaError(f"unknown event kind {kind!r}: {ev!r}")
+    for field, types in spec.items():
+        if field not in ev:
+            raise JournalSchemaError(
+                f"{kind!r} event missing required field {field!r}: {ev!r}"
+            )
+        v = ev[field]
+        if isinstance(v, bool) and bool not in types:
+            raise JournalSchemaError(
+                f"{kind!r} field {field!r} is bool, want "
+                f"{[t.__name__ for t in types]}: {ev!r}"
+            )
+        if not isinstance(v, types):
+            raise JournalSchemaError(
+                f"{kind!r} field {field!r} has type {type(v).__name__}, "
+                f"want one of {[t.__name__ for t in types]}: {ev!r}"
+            )
+    if kind == "final" and ev["verdict"] not in VERDICTS:
+        raise JournalSchemaError(
+            f"final verdict {ev['verdict']!r} not in {VERDICTS}"
+        )
+    return ev

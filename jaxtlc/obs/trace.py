@@ -1,0 +1,292 @@
+"""Chrome-trace (Perfetto) export of a run journal: the timeline tier.
+
+Renders the journal's host-observed intervals as a `chrome://tracing` /
+https://ui.perfetto.dev JSON file (`-trace-out run.trace.json`):
+
+* pid "device": one slice per supervised segment (dispatch -> fence),
+  subdivided into per-level expand/commit sub-slices on two threads.
+  Per-level spans are SCHEMATIC - body-count-proportional placement
+  inside the segment's host-observed wall, since the device does not
+  timestamp individual levels - but their overlap structure is real:
+  in pipeline mode the commit lane of level k overlaps the expand lane
+  of level k+1 (the staged-block schedule), in fused mode they abut.
+  Ground-truth device timelines come from `-xprof DIR` (jax.profiler).
+* pid "host": checkpoint-write and regrow-migration slices, plus
+  instant markers for retries, faults, interruption, recovery and the
+  final verdict - so "why was this segment slow" is one glance (the
+  TensorFlow timeline discipline, arXiv:1605.08695 §5).
+* counter tracks: distinct states, queue depth and fingerprint-table
+  load per level, which Perfetto renders as rate/occupancy graphs.
+
+The export is a pure function of the journal events (obs.journal), so
+it can be produced live (`-trace-out`), after the fact from any
+journal file (`python -m jaxtlc.obs.trace run.journal.jsonl`), or
+across an interruption - a SIGTERM'd + `-recover`ed run's single
+continuous journal renders as one timeline with the gap visible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+PID_DEVICE = 1
+PID_HOST = 2
+TID_SEGMENT = 1
+TID_EXPAND = 2
+TID_COMMIT = 3
+TID_CKPT = 1
+TID_REGROW = 2
+
+
+def _meta(pid: int, name: str) -> dict:
+    return {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name}}
+
+
+def _thread(pid: int, tid: int, name: str) -> dict:
+    return {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": name}}
+
+
+def chrome_trace_events(events: List[dict]) -> List[dict]:
+    """The journal -> traceEvents transform (timestamps in us, relative
+    to the first journal event)."""
+    if not events:
+        return []
+    t0 = events[0]["t"]
+    us = lambda t: (t - t0) * 1e6  # noqa: E731
+
+    pipeline = False
+    for ev in events:
+        if ev["event"] == "run_start":
+            pipeline = bool(ev.get("params", {}).get("pipeline"))
+            break
+
+    out = [
+        _meta(PID_DEVICE, "device engine"),
+        _meta(PID_HOST, "host (checkpoint/regrow)"),
+        _thread(PID_DEVICE, TID_SEGMENT, "segments"),
+        _thread(PID_DEVICE, TID_EXPAND, "expand (per level, schematic)"),
+        _thread(PID_DEVICE, TID_COMMIT, "commit (per level, schematic)"),
+        _thread(PID_HOST, TID_CKPT, "checkpoint writes"),
+        _thread(PID_HOST, TID_REGROW, "regrow migrations"),
+    ]
+
+    def instant(ev, name, args=None):
+        out.append({"name": name, "ph": "i", "s": "g",
+                    "ts": us(ev["t"]), "pid": PID_HOST, "tid": TID_CKPT,
+                    "args": args or {}})
+
+    # level events journal at the fence AFTER the segment they ran in:
+    # walk in order, buffering levels against the most recent segment
+    pending_levels: List[dict] = []
+    last_segment = None
+
+    def flush_levels():
+        """Subdivide the last segment's wall among its buffered levels
+        (body-count-proportional), emitting expand/commit sub-slices
+        whose overlap mirrors the engine's step schedule."""
+        nonlocal pending_levels
+        seg, levels = last_segment, pending_levels
+        pending_levels = []
+        if seg is None or not levels:
+            return
+        seg_ts = us(seg["t_dispatch"])
+        seg_dur = max(seg["wall_s"] * 1e6, 1.0)
+        bodies = [max(lv.get("bodies_level", 1), 1) for lv in levels]
+        total = float(sum(bodies))
+        cursor = seg_ts
+        for lv, b in zip(levels, bodies):
+            dur = seg_dur * (b / total)
+            half = dur / 2.0
+            args = {k: lv[k] for k in
+                    ("level", "generated", "distinct", "queue",
+                     "bodies", "expanded") if k in lv}
+            if pipeline:
+                # staged schedule: commit of level k rides alongside the
+                # NEXT level's expansion - draw commit shifted half a
+                # span so the overlap is visible in the two lanes
+                out.append({"name": f"expand L{lv['level']}", "ph": "X",
+                            "ts": cursor, "dur": dur, "pid": PID_DEVICE,
+                            "tid": TID_EXPAND, "args": args})
+                out.append({"name": f"commit L{lv['level']}", "ph": "X",
+                            "ts": cursor + half, "dur": dur,
+                            "pid": PID_DEVICE, "tid": TID_COMMIT,
+                            "args": args})
+            else:
+                out.append({"name": f"expand L{lv['level']}", "ph": "X",
+                            "ts": cursor, "dur": half,
+                            "pid": PID_DEVICE, "tid": TID_EXPAND,
+                            "args": args})
+                out.append({"name": f"commit L{lv['level']}", "ph": "X",
+                            "ts": cursor + half, "dur": half,
+                            "pid": PID_DEVICE, "tid": TID_COMMIT,
+                            "args": args})
+            out.append({"name": "states", "ph": "C",
+                        "ts": cursor + dur, "pid": PID_DEVICE, "tid": 0,
+                        "args": {"distinct": lv["distinct"],
+                                 "queue": lv["queue"]}})
+            if "fp_load" in lv:
+                out.append({"name": "fp_load", "ph": "C",
+                            "ts": cursor + dur, "pid": PID_DEVICE,
+                            "tid": 0,
+                            "args": {"load": lv["fp_load"]}})
+            cursor += dur
+
+    prev_level = None
+    for ev in events:
+        kind = ev["event"]
+        if kind == "segment":
+            flush_levels()
+            last_segment = ev
+            out.append({
+                "name": f"segment {ev['index']}", "ph": "X",
+                "ts": us(ev["t_dispatch"]),
+                "dur": max(ev["wall_s"] * 1e6, 1.0),
+                "pid": PID_DEVICE, "tid": TID_SEGMENT,
+                "args": {"index": ev["index"],
+                         "wall_s": ev["wall_s"]},
+            })
+        elif kind == "level":
+            lv = dict(ev)
+            # per-level body count from the cumulative counter
+            lv["bodies_level"] = (
+                ev["bodies"] - prev_level["bodies"]
+                if prev_level is not None else ev["bodies"]
+            )
+            prev_level = ev
+            pending_levels.append(lv)
+        elif kind == "checkpoint":
+            out.append({
+                "name": f"checkpoint ({ev['label']})", "ph": "X",
+                "ts": us(ev["t"] - ev["seconds"]),
+                "dur": max(ev["seconds"] * 1e6, 1.0),
+                "pid": PID_HOST, "tid": TID_CKPT,
+                "args": {"path": ev["path"]},
+            })
+        elif kind == "regrow":
+            out.append({
+                "name": f"regrow {ev['resource']}", "ph": "X",
+                "ts": us(ev["t"] - ev["seconds"]),
+                "dur": max(ev["seconds"] * 1e6, 1.0),
+                "pid": PID_HOST, "tid": TID_REGROW,
+                "args": {"old": ev["old"], "new": ev["new"],
+                         "violation": ev["violation"]},
+            })
+        elif kind == "retry":
+            instant(ev, f"retry #{ev['attempt']}",
+                    {"error": ev["error"]})
+        elif kind == "fault":
+            instant(ev, f"fault {ev['kind']}@{ev['at']}")
+        elif kind == "interrupted":
+            instant(ev, f"interrupted (signal {ev['signum']})",
+                    {"checkpoint": ev["path"]})
+        elif kind in ("recovery", "run_resume"):
+            instant(ev, kind, {"path": ev["path"]})
+        elif kind == "final":
+            instant(ev, f"final: {ev['verdict']}",
+                    {"generated": ev["generated"],
+                     "distinct": ev["distinct"],
+                     "wall_s": ev["wall_s"]})
+    flush_levels()
+    return out
+
+
+def export_chrome_trace(events: List[dict], path: str) -> int:
+    """Write the Perfetto-loadable JSON for `events` to `path` (fsync +
+    rename, the checkpoint durability discipline).  Returns the number
+    of trace events written."""
+    from ..engine.checkpoint import fsync_replace
+
+    trace = chrome_trace_events(events)
+    doc = {"traceEvents": trace, "displayTimeUnit": "ms",
+           "otherData": {"producer": "jaxtlc obs.trace"}}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+        fsync_replace(tmp, path, f=f)
+    return len(trace)
+
+
+def _tiny_journal(path: str) -> None:
+    """A synthetic but schema-valid journal exercising every event kind
+    the exporter renders (the --tiny smoke's input)."""
+    from .journal import RunJournal
+
+    with RunJournal(path) as j:
+        base = j.event("run_start", version="tiny", workload="FF",
+                       engine="single", device="cpu",
+                       params={"pipeline": True, "chunk": 128})["t"]
+        for s in range(2):
+            td = base + 0.1 * s
+            j.event("segment", index=s, t_dispatch=td,
+                    t_fence=td + 0.09, wall_s=0.09)
+            for i in range(2):
+                lvl = 2 * s + i + 1
+                j.event("level", level=lvl, generated=100 * lvl,
+                        distinct=60 * lvl, queue=30, bodies=4 * lvl,
+                        expanded=50 * lvl, fp_load=0.01 * lvl)
+            j.event("progress", depth=2 * s + 2, generated=200 * (s + 1),
+                    distinct=120 * (s + 1), queue=30)
+        j.event("checkpoint", path="ck.g000001.npz", seconds=0.004,
+                label="periodic")
+        j.event("regrow", resource="fp_capacity", old=1 << 11,
+                new=1 << 12, violation="fpset full", seconds=0.01)
+        j.event("retry", attempt=1, delay_s=0.01, error="injected")
+        j.event("interrupted", signum=15, path=None, generated=400,
+                distinct=240, queue=30, wall_s=0.2)
+        j.event("final", verdict="interrupted", generated=400,
+                distinct=240, depth=4, queue=30, wall_s=0.2,
+                interrupted=True)
+
+
+def main(argv=None) -> int:
+    """CLI: `python -m jaxtlc.obs.trace JOURNAL [-o OUT]` exports a
+    journal file; `--tiny` self-tests the whole pipeline on a synthetic
+    journal (wired into tier-1, the profile_v4 --tiny pattern)."""
+    import argparse
+    import sys
+    import tempfile
+
+    from . import journal as jr
+
+    p = argparse.ArgumentParser(prog="jaxtlc.obs.trace")
+    p.add_argument("journal", nargs="?", help="run journal (JSONL)")
+    p.add_argument("-o", "--out", default="", help="trace output path "
+                   "(default: <journal>.trace.json)")
+    p.add_argument("--tiny", action="store_true",
+                   help="smoke: synthesize a journal, export it, "
+                        "validate the result")
+    args = p.parse_args(argv)
+    if args.tiny:
+        with tempfile.TemporaryDirectory() as d:
+            jpath = os.path.join(d, "tiny.journal.jsonl")
+            _tiny_journal(jpath)
+            events = jr.read(jpath)
+            out = args.out or os.path.join(d, "tiny.trace.json")
+            n = export_chrome_trace(events, out)
+            with open(out) as f:
+                doc = json.load(f)
+            assert doc["traceEvents"] and n == len(doc["traceEvents"])
+            names = {e.get("name", "") for e in doc["traceEvents"]}
+            assert any(s.startswith("expand L") for s in names)
+            assert any(s.startswith("commit L") for s in names)
+        print(f"trace-export tiny OK: {n} trace events "
+              f"({len(events)} journal events)")
+        return 0
+    if not args.journal:
+        p.error("journal path required (or --tiny)")
+    events = jr.read(args.journal, validate=False)
+    out = args.out or args.journal + ".trace.json"
+    n = export_chrome_trace(events, out)
+    print(f"wrote {n} trace events from {len(events)} journal events "
+          f"to {out}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

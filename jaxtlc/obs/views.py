@@ -1,0 +1,126 @@
+"""Derived views of the run journal.
+
+The journal (obs.journal) is the single source of truth for run
+telemetry; everything user-facing renders FROM it:
+
+* `render_tlc_event` - the TLC structured-log banners (2200 Progress,
+  2195 checkpoint, 2196 recovery, 2198 regrow, ...) as a pure function
+  of one journal event, used by the CLI's supervisor hook.  The 2200
+  line's per-minute rates come from io.tlc_log's stored previous
+  progress report, exactly as TLC computes them.
+* `interval_rates` - the shared rate arithmetic (states/min between two
+  observations), used by TLCLog and tools/tlcstat.py alike so the
+  progress line and the dashboard can never disagree.
+* `bench_payload` - the BENCH_*.json line contract: every bench.py
+  payload is stamped through a journal as a `bench_metric` event, so
+  the required metric/unit/vs_baseline fields are schema-enforced at
+  emit time instead of by reviewer eyeball.
+* `eta_s` - queue-drain ETA from the two most recent observations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from .journal import RunJournal
+
+
+def interval_rates(prev: Optional[Tuple[float, int, int]],
+                   now: float, generated: int,
+                   distinct: int) -> Tuple[int, int]:
+    """(states/min, distinct-states/min) between two observations.
+
+    With no previous observation TLC reports the raw first-interval
+    counts as the per-minute figures (MC.out:35); we do the same."""
+    if prev is None or now <= prev[0]:
+        return generated, distinct
+    dt = now - prev[0]
+    return (
+        int((generated - prev[1]) * 60 / dt),
+        int((distinct - prev[2]) * 60 / dt),
+    )
+
+
+def eta_s(prev: Optional[dict], cur: dict) -> Optional[float]:
+    """Seconds until the current queue drains at the current distinct-
+    state rate - the rough time-to-exhaustive figure tlcstat prints.
+    None when the rate is unknown or zero (first report / stalled)."""
+    if prev is None:
+        return None
+    dt = cur["t"] - prev["t"]
+    dd = cur["distinct"] - prev["distinct"]
+    if dt <= 0 or dd <= 0:
+        return None
+    return cur["queue"] / (dd / dt)
+
+
+def render_tlc_event(log, ev: dict, resume_cmd: str = "") -> None:
+    """Render one journal event as its TLC structured-log banner.
+
+    The inverse direction of the old ad-hoc wiring: the journal event
+    is primary, the 2200/2195/2196/2198 lines are derived from it.
+    Unknown kinds render nothing (the journal may carry events - levels,
+    segments - that have no TLC-line analog)."""
+    kind = ev["event"]
+    if kind == "progress":
+        log.progress(ev["depth"], ev["generated"], ev["distinct"],
+                     ev["queue"])
+    elif kind == "checkpoint":
+        log.checkpoint_saved(ev["path"])
+    elif kind == "recovery":
+        log.recovery(ev["path"], ev["distinct"])
+    elif kind == "regrow":
+        log.regrow(ev["resource"], ev["old"], ev["new"], ev["violation"])
+    elif kind == "retry":
+        log.msg(
+            1000,
+            f"Transient error (attempt {ev['attempt']}): {ev['error']}; "
+            f"retrying in {ev['delay_s']}s from the last good state.",
+            severity=1,
+        )
+    elif kind == "ckpt_write_failed":
+        log.msg(
+            1000,
+            f"Checkpoint write failed: {ev['error']} (run continues; "
+            "the next segment boundary retries).",
+            severity=1,
+        )
+    elif kind == "ckpt_fallback":
+        log.msg(
+            1000,
+            f"Checkpoint {ev['path']} failed verification "
+            f"({ev['error']}); falling back to the previous generation.",
+            severity=1,
+        )
+    elif kind == "interrupted":
+        log.interrupted(ev["signum"], ev["path"], resume_cmd)
+
+
+_BENCH_BASE = {
+    "metric": "distinct_states_per_s",
+    "value": 0,
+    "unit": "states/s",
+    "vs_baseline": 0,
+    "pipeline": False,
+}
+
+
+def bench_payload(payload: dict,
+                  journal: Optional[RunJournal] = None) -> dict:
+    """Assemble one bench metric line: base contract fields + `payload`,
+    schema-validated by stamping it through a journal as a
+    `bench_metric` event (an in-memory journal when none is given).
+    Returns the payload WITHOUT the journal envelope - the emitted JSON
+    line is byte-compatible with every committed BENCH_*.json."""
+    out = dict(_BENCH_BASE)
+    out.update(payload)
+    j = journal if journal is not None else RunJournal()
+    if "error" in out:
+        # failure payloads carry the contract fields too (zeroed metric)
+        j.event("bench_metric", **{
+            k: out.get(k, _BENCH_BASE.get(k)) for k in
+            ("metric", "value", "unit", "vs_baseline")
+        }, error=str(out["error"]))
+    else:
+        j.event("bench_metric", **out)
+    return out

@@ -144,6 +144,14 @@ def migrate_engine_carry(
                       "st_action", "st_gen", "st_n", "st_viol",
                       "st_viol_state", "st_viol_action")
         }
+    # observability ring: telemetry only, its shape depends on neither
+    # capacity - travels verbatim so per-level history survives regrow
+    if carry.obs_ring is not None:
+        staged.update({
+            f: jnp.asarray(np.asarray(getattr(carry, f)))
+            for f in ("obs_ring", "obs_head", "obs_bodies",
+                      "obs_expanded")
+        })
 
     return EngineCarry(
         fps=fps2,
@@ -239,6 +247,12 @@ def migrate_shard_carry(
             for f in ("pv_send", "pv_sown", "pv_pos", "pv_svalid",
                       "pv_order", "pv_faction", "pv_n")
         }
+    if carry.obs_ring is not None:
+        pv.update({
+            f: jnp.asarray(np.asarray(getattr(carry, f)))
+            for f in ("obs_ring", "obs_head", "obs_bodies",
+                      "obs_expanded")
+        })
     return ShardCarry(
         table=jnp.asarray(table2),
         queue=jnp.asarray(queue2),

@@ -179,13 +179,14 @@ class SingleDeviceAdapter:
     kind = "single"
     GEOM_KEYS = ("queue_capacity", "fp_capacity")
     FIXED_KEYS = ("format", "config", "chunk", "fp_index", "seed",
-                  "fp_highwater", "pipeline")
+                  "fp_highwater", "pipeline", "obs_slots")
 
     def __init__(self, cfg, chunk: int = 1024,
                  fp_index: int = DEFAULT_FP_INDEX, seed: int = DEFAULT_SEED,
                  fp_highwater: float = DEFAULT_FP_HIGHWATER,
                  backend=None, meta_config: dict = None,
-                 check_deadlock: bool = True, pipeline: bool = False):
+                 check_deadlock: bool = True, pipeline: bool = False,
+                 obs_slots: int = 0):
         self.cfg = cfg
         self.chunk = chunk
         self.fp_index = fp_index
@@ -195,6 +196,7 @@ class SingleDeviceAdapter:
         self.meta_config = meta_config
         self.check_deadlock = check_deadlock
         self.pipeline = pipeline
+        self.obs_slots = obs_slots
 
     def build(self, params: dict, ckpt_every: int):
         # donate=False: the supervisor feeds the SAME last-good carry
@@ -209,6 +211,7 @@ class SingleDeviceAdapter:
                 fp_highwater=self.fp_highwater,
                 check_deadlock=self.check_deadlock,
                 pipeline=self.pipeline, donate=False,
+                obs_slots=self.obs_slots,
             )
         else:
             init_fn, _, step_fn = make_engine(
@@ -216,6 +219,7 @@ class SingleDeviceAdapter:
                 params["fp_capacity"], self.fp_index, self.seed,
                 fp_highwater=self.fp_highwater,
                 pipeline=self.pipeline, donate=False,
+                obs_slots=self.obs_slots,
             )
 
         @jax.jit
@@ -235,6 +239,7 @@ class SingleDeviceAdapter:
             self.cfg, meta_config=self.meta_config, chunk=self.chunk,
             fp_index=self.fp_index, seed=self.seed,
             fp_highwater=self.fp_highwater, pipeline=self.pipeline,
+            obs_slots=self.obs_slots,
             **params,
         )
 
@@ -243,6 +248,14 @@ class SingleDeviceAdapter:
 
     def done(self, carry) -> bool:
         return carry_done(carry)
+
+    def obs_rows(self, carry, since: int, params: dict):
+        """New observability-ring rows since cursor `since` (journal
+        `level` events); ([], since) when obs is off."""
+        from ..engine.bfs import obs_rows
+
+        return obs_rows(carry, since=since,
+                        fp_capacity=params["fp_capacity"])
 
     def progress(self, carry):
         # one batched device_get instead of four blocking scalar pulls;
@@ -282,12 +295,12 @@ class ShardedAdapter:
     kind = "sharded"
     GEOM_KEYS = ("queue_capacity", "fp_capacity", "route_factor")
     FIXED_KEYS = ("format", "config", "devices", "fp_highwater",
-                  "pipeline")
+                  "pipeline", "obs_slots")
 
     def __init__(self, cfg, mesh, chunk: int = 512, backend=None,
                  meta_config: dict = None,
                  fp_highwater: float = DEFAULT_FP_HIGHWATER,
-                 pipeline: bool = False):
+                 pipeline: bool = False, obs_slots: int = 0):
         from ..engine.sharded import kubeapi_backend
 
         self.cfg = cfg
@@ -297,6 +310,7 @@ class ShardedAdapter:
         self.meta_config = meta_config
         self.fp_highwater = fp_highwater
         self.pipeline = pipeline
+        self.obs_slots = obs_slots
 
     def build(self, params: dict, ckpt_every: int):
         from ..engine.sharded import make_sharded_engine
@@ -306,7 +320,7 @@ class ShardedAdapter:
             params["queue_capacity"], params["fp_capacity"],
             route_factor=params["route_factor"], segment=ckpt_every,
             backend=self.backend, fp_highwater=self.fp_highwater,
-            pipeline=self.pipeline,
+            pipeline=self.pipeline, obs_slots=self.obs_slots,
         )
         template = init_fn()
         compiled = seg_fn.lower(template).compile()
@@ -318,6 +332,7 @@ class ShardedAdapter:
             self.cfg, meta_config=self.meta_config, chunk=self.chunk,
             devices=int(self.mesh.devices.size),
             fp_highwater=self.fp_highwater, pipeline=self.pipeline,
+            obs_slots=self.obs_slots,
             **params,
         )
 
@@ -338,6 +353,15 @@ class ShardedAdapter:
             int(np.asarray(g).sum()),
             int(np.asarray(di).sum()),
             int((np.asarray(qt) - np.asarray(qh)).sum()),
+        )
+
+    def obs_rows(self, carry, since: int, params: dict):
+        from ..engine.sharded import obs_rows_sharded
+
+        return obs_rows_sharded(
+            carry, since=since,
+            fp_capacity_total=(params["fp_capacity"]
+                               * int(self.mesh.devices.size)),
         )
 
     def migrate(self, carry, old_params: dict, new_params: dict):
@@ -364,9 +388,10 @@ def _params_from_meta(adapter, meta: dict, params: dict) -> dict:
     travel with the snapshot, so the resume command needs none of them)."""
     want = adapter.meta(params)
     for key in adapter.FIXED_KEYS:
-        # pre-pipeline snapshots carry no "pipeline" key: they were cut
-        # from the unpipelined engine, so missing means False
-        have = meta.get(key, False if key == "pipeline" else None)
+        # pre-pipeline/pre-obs snapshots carry no key: they were cut
+        # from engines without those features, so missing means off
+        have = meta.get(key, False if key == "pipeline"
+                        else 0 if key == "obs_slots" else None)
         if have != want.get(key):
             raise ValueError(
                 f"checkpoint {key} mismatch: "
@@ -481,6 +506,14 @@ def supervise(adapter, params: dict,
         return path
 
     good = carry
+    # observability cursor: ring rows below this head are already
+    # journaled.  A resumed carry starts past its restored history (the
+    # original journal already holds those levels); regrow/retry replays
+    # re-derive rows below the cursor bit-for-bit, so nothing duplicates.
+    obs_read = getattr(adapter, "obs_rows", None)
+    obs_seen = 0
+    if obs_read is not None:
+        _, obs_seen = obs_read(carry, 0, params)
     # deferred periodic checkpoint: written while the NEXT segment is in
     # flight, so snapshot serialization/fsync overlaps device execution
     # instead of stalling the step loop (the carry is safe to read
@@ -511,11 +544,13 @@ def supervise(adapter, params: dict,
             while True:
                 try:
                     faults.segment_start(segments)
+                    t_dispatch = time.time()
                     in_flight = seg_fn(good)
                     # host work overlapping the running segment: the
                     # previous segment's checkpoint write + progress line
                     flush_save()
                     carry2 = jax.block_until_ready(in_flight)
+                    t_fence = time.time()
                     break
                 except _TRANSIENT as e:
                     if attempt >= opts.retries:
@@ -582,12 +617,24 @@ def supervise(adapter, params: dict,
             carry = carry2
             good = carry2
             segments += 1
+            # timeline telemetry: the host-observed dispatch -> fence
+            # interval of the segment just completed (the trace
+            # exporter's device-track slices come from these)
+            _emit(opts, "segment", index=segments - 1,
+                  t_dispatch=t_dispatch, t_fence=t_fence,
+                  wall_s=round(t_fence - t_dispatch, 6))
             if opts.ckpt_path:
                 pending_save = good
             if adapter.viol(carry) == OK and not adapter.done(carry):
                 d, g, di, q = adapter.progress(carry)
                 _emit(opts, "progress", depth=d, generated=g,
                       distinct=di, queue=q)
+            if obs_read is not None:
+                # decode the counter ring's new per-level rows (the
+                # same fence the progress readback already paid for)
+                rows, obs_seen = obs_read(carry, obs_seen, params)
+                for row in rows:
+                    _emit(opts, "level", **row)
 
         # the final segment's snapshot has no next segment to hide
         # behind: write it at the fence
@@ -598,12 +645,28 @@ def supervise(adapter, params: dict,
                 path = save(good, "final")
             except OSError as e:
                 _emit(opts, "ckpt_write_failed", error=str(e))
+            # the structured interruption record carries the counters
+            # and wall time even when NO checkpoint path is configured
+            # (path None = progress lost): the journal still ends with
+            # an accountable event, never a silent death
+            d, g, di, q = adapter.progress(good)
             _emit(opts, "interrupted",
-                  signum=int(sig.hit) if sig.hit else None, path=path)
+                  signum=int(sig.hit) if sig.hit else None, path=path,
+                  generated=g, distinct=di, queue=q,
+                  wall_s=round(time.time() - t0, 6))
         else:
             flush_save()
 
-    result = adapter.result(carry, time.time() - t0, segments, params)
+    wall = time.time() - t0
+    result = adapter.result(carry, wall, segments, params)
+    # every supervised run ends with exactly one structured final event:
+    # verdict + counters + wall, whatever the exit path
+    verdict = ("interrupted" if interrupted
+               else "violation" if result.violation != OK else "ok")
+    _emit(opts, "final", verdict=verdict, generated=result.generated,
+          distinct=result.distinct, depth=result.depth,
+          queue=result.queue_left, wall_s=round(wall, 6),
+          interrupted=interrupted)
     return SupervisedResult(
         result=result,
         params=params,
@@ -629,6 +692,7 @@ def check_supervised(
     meta_config: dict = None,
     check_deadlock: bool = True,
     pipeline: bool = False,
+    obs_slots: int = 0,
     opts: SupervisorOptions = None,
 ) -> SupervisedResult:
     """Supervised single-device exhaustive check (the check_with_
@@ -639,7 +703,7 @@ def check_supervised(
         cfg, chunk=chunk, fp_index=fp_index, seed=seed,
         fp_highwater=fp_highwater, backend=backend,
         meta_config=meta_config, check_deadlock=check_deadlock,
-        pipeline=pipeline,
+        pipeline=pipeline, obs_slots=obs_slots,
     )
     return supervise(
         adapter,
@@ -659,12 +723,14 @@ def check_sharded_supervised(
     meta_config: dict = None,
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
     pipeline: bool = False,
+    obs_slots: int = 0,
     opts: SupervisorOptions = None,
 ) -> SupervisedResult:
     """Supervised mesh-sharded exhaustive check (capacities PER DEVICE)."""
     adapter = ShardedAdapter(
         cfg, mesh, chunk=chunk, backend=backend, meta_config=meta_config,
         fp_highwater=fp_highwater, pipeline=pipeline,
+        obs_slots=obs_slots,
     )
     return supervise(
         adapter,

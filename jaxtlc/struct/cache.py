@@ -102,17 +102,20 @@ def get_engine(
     fp_highwater: float,
     check_deadlock: bool = True,
     pipeline: bool = False,
+    obs_slots: int = 0,
 ) -> Tuple:
     """Memoized single-device engine triple (init_fn, run_fn, step_fn)
     for a struct model; enables the persistent XLA cache as a side
-    effect so the jit compiles it triggers land on disk."""
+    effect so the jit compiles it triggers land on disk.  obs_slots is
+    part of the key: the ring changes the carry pytree, so an obs-on
+    engine is a different compile than an obs-off one."""
     from ..engine.bfs import make_backend_engine
 
     enable_persistent_cache()
     key = (
         model_key(model), "single", chunk, queue_capacity, fp_capacity,
         fp_index, seed, fp_highwater, bool(check_deadlock),
-        bool(pipeline),
+        bool(pipeline), int(obs_slots),
     )
     hit = _ENGINE_MEMO.get(key)
     if hit is None:
@@ -120,6 +123,7 @@ def get_engine(
         hit = make_backend_engine(
             backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
             fp_highwater=fp_highwater, pipeline=pipeline,
+            obs_slots=obs_slots,
         )
         _ENGINE_MEMO[key] = hit
     return hit

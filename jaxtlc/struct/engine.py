@@ -52,12 +52,14 @@ def check_struct(
     check_deadlock: bool = True,
     fp_highwater: float = 0.85,
     pipeline: bool = False,
+    obs_slots: int = 0,
 ) -> CheckResult:
     """Exhaustive device check of a struct-compiled spec (single device,
     fused loop; AOT-compiled before timing like bfs.check)."""
     init_fn, run_fn, _ = get_engine(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
+        obs_slots=obs_slots,
     )
     backend = get_backend(model, check_deadlock)
     carry = init_fn()
@@ -80,6 +82,7 @@ def check_struct_sharded(
     route_factor: float = 2.0,
     check_deadlock: bool = True,
     pipeline: bool = False,
+    obs_slots: int = 0,
 ) -> CheckResult:
     """Exhaustive mesh-sharded check of a struct-compiled spec
     (capacities PER DEVICE; fingerprint-space all_to_all partitioning,
@@ -90,5 +93,5 @@ def check_struct_sharded(
     return check_sharded(
         None, mesh, chunk=chunk, queue_capacity=queue_capacity,
         fp_capacity=fp_capacity, route_factor=route_factor,
-        backend=backend, pipeline=pipeline,
+        backend=backend, pipeline=pipeline, obs_slots=obs_slots,
     )

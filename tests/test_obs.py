@@ -1,0 +1,292 @@
+"""Observability-plane tests (ISSUE 5 acceptance criteria).
+
+- golden journal: a real supervised FF run's journal validates line by
+  line against the versioned schema (obs/schema.py) - event-shape drift
+  is a loud tier-1 failure;
+- bit-for-bit: the counter ring is pure telemetry - an obs-on run's
+  full signature (counts, per-action, outdegree, fpset table words)
+  equals the obs-off engine's exactly;
+- SIGTERM'd -checkpoint run + -recover -> ONE continuous journal (the
+  resumed run APPENDS), trace export renders expand/commit lanes;
+- "progress lost" (SIGTERM with no checkpoint path) still ends the
+  journal with a structured final event (verdict, counters, wall);
+- the 2200 Progress line's interval rates are pinned byte-for-byte.
+"""
+
+import json
+import os
+import time as _time
+
+import numpy as np
+import pytest
+
+from jaxtlc.config import ModelConfig
+from jaxtlc.engine.bfs import check, obs_rows
+from jaxtlc.obs import journal as jr
+from jaxtlc.obs.schema import (
+    SCHEMA_VERSION,
+    JournalSchemaError,
+    validate_event,
+)
+from jaxtlc.obs.trace import export_chrome_trace
+from jaxtlc.resil import FaultPlan, SupervisorOptions, check_supervised
+
+FF = ModelConfig(False, False)
+EXPECT_FF = (17020, 8203, 109)
+KW = dict(chunk=128, queue_capacity=1 << 12, fp_capacity=1 << 14)
+
+
+def signature(r):
+    return (r.generated, r.distinct, r.depth, r.violation,
+            tuple(sorted(r.action_generated.items())),
+            tuple(sorted(r.action_distinct.items())),
+            r.outdegree)
+
+
+@pytest.fixture(scope="module")
+def clean_ff():
+    """The obs-off ground truth (raw fused engine)."""
+    return check(FF, **KW)
+
+
+@pytest.fixture(scope="module")
+def obs_run(tmp_path_factory):
+    """ONE supervised obs-on FF run journaling to disk: the golden
+    input shared by the schema/ring/trace tests below."""
+    d = tmp_path_factory.mktemp("obs")
+    path = str(d / "run.journal.jsonl")
+    with jr.RunJournal(path) as j:
+        j.event("run_start", version="test", workload="FF",
+                engine="single", device="cpu",
+                params={**KW, "obs_slots": 64, "pipeline": False})
+        sr = check_supervised(
+            FF, obs_slots=64,
+            opts=SupervisorOptions(
+                ckpt_every=16, on_event=lambda k, i: j.event(k, **i)
+            ),
+            **KW,
+        )
+    return sr, path
+
+
+def test_journal_schema_golden(obs_run):
+    """Every line of a real run's journal validates against the
+    versioned schema; the run ends with exactly one final event."""
+    sr, path = obs_run
+    events = jr.read(path)  # validate=True: schema-checks every line
+    assert events, "journal must not be empty"
+    for ev in events:
+        assert ev["v"] == SCHEMA_VERSION
+        validate_event(ev)  # belt and braces (read() already did)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start"
+    assert kinds.count("final") == 1 and kinds[-1] == "final"
+    fin = events[-1]
+    assert fin["verdict"] == "ok" and not fin["interrupted"]
+    assert (fin["generated"], fin["distinct"], fin["depth"]) == EXPECT_FF
+    assert fin["wall_s"] > 0
+
+
+def test_obs_bit_identical_and_ring(obs_run, clean_ff):
+    """Acceptance: obs-on results == obs-off engine bit-for-bit, and
+    the ring's per-level rows are exact cumulative telemetry."""
+    sr, path = obs_run
+    assert signature(sr.result) == signature(clean_ff)
+    levels = [e for e in jr.read(path) if e["event"] == "level"]
+    assert len(levels) == EXPECT_FF[2]  # one row per BFS level
+    lvls = [e["level"] for e in levels]
+    assert lvls == list(range(1, EXPECT_FF[2] + 1))
+    last = levels[-1]
+    assert last["generated"] == EXPECT_FF[0]
+    assert last["distinct"] == EXPECT_FF[1]
+    assert last["queue"] == 0
+    assert last["expanded"] == EXPECT_FF[1]  # every distinct expanded
+    assert last["fp_load"] == pytest.approx(8203 / (1 << 14), rel=1e-3)
+    # cumulative counters are monotone
+    for a, b in zip(levels, levels[1:]):
+        assert b["generated"] >= a["generated"]
+        assert b["distinct"] >= a["distinct"]
+        assert b["bodies"] > a["bodies"]
+
+
+def test_obs_ring_survives_regrow(clean_ff):
+    """Undersized run: auto-regrow migrates the ring verbatim, the
+    final statistics still match the clean run exactly and the ring's
+    last row matches the final counters."""
+    sr = check_supervised(
+        FF, chunk=128, queue_capacity=1 << 8, fp_capacity=1 << 11,
+        obs_slots=64, opts=SupervisorOptions(ckpt_every=8),
+    )
+    assert sr.regrows >= 1
+    assert signature(sr.result) == signature(clean_ff)
+
+
+def test_trace_export_from_golden_journal(obs_run, tmp_path):
+    """The journal renders to a Perfetto-loadable Chrome trace with the
+    expand/commit lanes and counter tracks present."""
+    _, path = obs_run
+    out = str(tmp_path / "run.trace.json")
+    n = export_chrome_trace(jr.read(path), out)
+    doc = json.load(open(out))
+    assert len(doc["traceEvents"]) == n > 0
+    names = [e.get("name", "") for e in doc["traceEvents"]]
+    assert any(s.startswith("segment") for s in names)
+    assert any(s.startswith("expand L") for s in names)
+    assert any(s.startswith("commit L") for s in names)
+    assert "states" in names  # counter track (ph: C)
+    phases = {e.get("ph") for e in doc["traceEvents"]}
+    assert {"X", "C", "M"} <= phases
+
+
+def test_progress_lost_still_emits_final(tmp_path):
+    """Satellite: SIGTERM with NO checkpoint path ("progress lost")
+    still ends the journal with the structured final event - verdict,
+    counters, wall time - via the faults DSL sigterm@K plan."""
+    path = str(tmp_path / "lost.journal.jsonl")
+    with jr.RunJournal(path) as j:
+        sr = check_supervised(
+            FF, obs_slots=64,
+            opts=SupervisorOptions(
+                ckpt_every=8,
+                faults=FaultPlan.parse("sigterm@2"),
+                on_event=lambda k, i: j.event(k, **i),
+            ),
+            **KW,
+        )
+    assert sr.interrupted
+    events = jr.read(path)  # schema-validates
+    ints = [e for e in events if e["event"] == "interrupted"]
+    assert len(ints) == 1
+    # no checkpoint configured: path is None but the counters are there
+    assert ints[0]["path"] is None
+    assert ints[0]["generated"] > 0 and ints[0]["wall_s"] > 0
+    fin = events[-1]
+    assert fin["event"] == "final" and fin["verdict"] == "interrupted"
+    assert fin["interrupted"] and fin["queue"] > 0
+    assert fin["distinct"] == sr.result.distinct
+
+
+def test_cli_sigterm_recover_one_continuous_journal(tmp_path, capsys):
+    """Acceptance: a SIGTERM'd -checkpoint CLI run followed by -recover
+    produces ONE continuous journal (run_start ... interrupted ...
+    run_resume ... final ok) that validates, and whose trace export
+    carries the expand/commit overlap lanes."""
+    from jaxtlc.cli import main
+
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "MC.tla").write_text(
+        "---- MODULE MC ----\nEXTENDS KubeAPI, TLC\n\n"
+        "\\* CONSTANT definitions @modelParameterConstants:1"
+        "REQUESTS_CAN_FAIL\nconst_fail ==\nFALSE\n\n"
+        "\\* CONSTANT definitions @modelParameterConstants:2"
+        "REQUESTS_CAN_TIMEOUT\nconst_to ==\nFALSE\n====\n"
+    )
+    (d / "MC.cfg").write_text(
+        "CONSTANT defaultInitValue = defaultInitValue\n"
+        "CONSTANT REQUESTS_CAN_FAIL <- const_fail\n"
+        "CONSTANT REQUESTS_CAN_TIMEOUT <- const_to\n"
+        "SPECIFICATION Spec\nINVARIANT TypeOK\nINVARIANT OnlyOneVersion\n"
+    )
+    ck = str(d / "ck.npz")
+    trace = str(d / "run.trace.json")
+    flags = ["-noTool", "-chunk", "128", "-qcap", "4096",
+             "-fpcap", "16384", "-checkpoint", ck,
+             "-checkpointevery", "8"]
+    rc = main(["check", str(d / "MC.cfg"), *flags,
+               "-faults", "sigterm@2"])
+    assert rc == 75  # EXIT_INTERRUPTED
+    jpath = ck + ".journal.jsonl"
+    assert os.path.exists(jpath)  # journals beside the checkpoint
+    rc = main(["check", str(d / "MC.cfg"), *flags, "-recover",
+               "-trace-out", trace])
+    assert rc == 0
+    capsys.readouterr()
+    events = jr.read(jpath)  # every line of BOTH attempts validates
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start"
+    for needle in ("interrupted", "run_resume", "recovery", "level"):
+        assert needle in kinds, f"journal lost {needle}: {kinds}"
+    finals = [e for e in events if e["event"] == "final"]
+    assert [f["verdict"] for f in finals] == ["interrupted", "ok"]
+    assert finals[-1]["distinct"] == EXPECT_FF[1]
+    # the resumed run continues level numbering, never restarts it
+    levels = [e["level"] for e in events if e["event"] == "level"]
+    assert levels == sorted(levels) and len(levels) == len(set(levels))
+    doc = json.load(open(trace))
+    names = [e.get("name", "") for e in doc["traceEvents"]]
+    assert any(s.startswith("interrupted") for s in names)
+    assert any(s.startswith("expand L") for s in names)
+    assert any(s.startswith("commit L") for s in names)
+
+
+def test_schema_rejects_drift():
+    """Shape drift is loud: unknown kinds, missing fields, wrong types
+    and future schema versions all raise."""
+    ok = {"v": SCHEMA_VERSION, "t": 1.0, "event": "progress",
+          "depth": 1, "generated": 2, "distinct": 2, "queue": 0}
+    validate_event(ok)
+    with pytest.raises(JournalSchemaError):
+        validate_event({**ok, "event": "no_such_kind"})
+    with pytest.raises(JournalSchemaError):
+        validate_event({k: v for k, v in ok.items() if k != "depth"})
+    with pytest.raises(JournalSchemaError):
+        validate_event({**ok, "generated": "lots"})
+    with pytest.raises(JournalSchemaError):
+        validate_event({**ok, "v": SCHEMA_VERSION + 1})
+    with pytest.raises(JournalSchemaError):
+        validate_event({"v": SCHEMA_VERSION, "t": 1.0, "event": "final",
+                        "verdict": "maybe", "generated": 1,
+                        "distinct": 1, "depth": 1, "queue": 0,
+                        "wall_s": 0.1, "interrupted": False})
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    """The crash window: an append cut mid-write leaves a partial final
+    line, which the reader skips; a torn line mid-file is corruption."""
+    path = str(tmp_path / "j.jsonl")
+    with jr.RunJournal(path) as j:
+        j.event("progress", depth=1, generated=2, distinct=2, queue=0)
+        j.event("progress", depth=2, generated=4, distinct=3, queue=1)
+    with open(path, "a") as f:
+        f.write('{"v": 1, "t": 3.0, "event": "prog')  # torn append
+    events = jr.read(path)
+    assert len(events) == 2 and events[-1]["depth"] == 2
+    # mid-file tear = corruption, must raise
+    lines = open(path).read().splitlines()
+    torn = [lines[0], '{"torn mid-file'] + lines[1:]
+    with open(path, "w") as f:
+        f.write("\n".join(torn) + "\n")
+    with pytest.raises(JournalSchemaError):
+        jr.read(path)
+
+
+def test_progress_line_interval_rates_pinned(capsys, monkeypatch):
+    """Satellite: the 2200 Progress line's interval rates, rendered
+    byte-for-byte.  First report prints the raw counts as rates (TLC's
+    convention, MC.out:35); the second prints true per-minute rates
+    from the stored _prev_progress tuple."""
+    from jaxtlc.io.tlc_log import TLCLog
+
+    clock = {"now": 1_000.0}
+    monkeypatch.setattr(_time, "time", lambda: clock["now"])
+    monkeypatch.setattr(
+        _time, "strftime", lambda fmt, *a: "2026-08-04 12:00:00"
+    )
+    log = TLCLog(tool_mode=False)
+    log.progress(10, 1000, 600, 50)
+    clock["now"] = 1_030.0  # 30 s later
+    log.progress(20, 31_000, 15_600, 70)
+    out = capsys.readouterr().out.splitlines()
+    assert out[0] == (
+        "Progress(10) at 2026-08-04 12:00:00: 1,000 states generated "
+        "(1,000 s/min), 600 distinct states found (600 ds/min), "
+        "50 states left on queue."
+    )
+    # (31,000-1,000)*60/30 = 60,000 s/min; (15,600-600)*60/30 = 30,000
+    assert out[1] == (
+        "Progress(20) at 2026-08-04 12:00:00: 31,000 states generated "
+        "(60,000 s/min), 15,600 distinct states found (30,000 ds/min), "
+        "70 states left on queue."
+    )
+    assert log._prev_progress == (1_030.0, 31_000, 15_600)

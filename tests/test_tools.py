@@ -1,16 +1,33 @@
-"""Tooling smoke: the profiler must not silently rot (ISSUE 4).
+"""Tooling smoke: the instruments must not silently rot (ISSUEs 4, 5).
 
-tools/profile_v4.py is the instrument every PERF.md round leans on; a
-broken import or a drifted engine signature must show up in tier-1, not
-on the next TPU session.  --tiny runs the WHOLE profiler (every phase
-closure plus the round-7 expand/commit attribution and the pipelined
-step timing) on the FF corner in-process.
+tools/profile_v4.py is the instrument every PERF.md round leans on;
+tools/tlcstat.py and the Chrome-trace exporter are the observability
+plane's operator surface; bench.py's metric payloads are the BENCH_*
+history contract.  A broken import, drifted engine signature, or a
+payload missing its required fields must show up in tier-1, not on the
+next TPU session.  Each tool's --tiny runs its WHOLE pipeline
+in-process.
 """
 
+import glob
 import importlib.util
 import io
+import json
 import os
 from contextlib import redirect_stdout
+
+import pytest
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name,
+        os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     f"{name}.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def test_profile_v4_tiny_smoke(capsys):
@@ -36,3 +53,75 @@ def test_profile_v4_tiny_smoke(capsys):
         "overlap efficiency:",
     ):
         assert needle in out, f"profiler output lost {needle!r}:\n{out}"
+
+
+def test_tlcstat_tiny_smoke(capsys):
+    """tlcstat --tiny renders a full dashboard frame from a synthetic
+    journal (rates, occupancy, ETA, verdict) - the whole read/render
+    pipeline, no engine run."""
+    mod = _load_tool("tlcstat")
+    assert mod.main(["--tiny"]) == 0
+    out = capsys.readouterr().out
+    for needle in ("ds/min", "fp table", "ETA", "VERDICT:",
+                   "tlcstat tiny OK"):
+        assert needle in out, f"tlcstat output lost {needle!r}:\n{out}"
+
+
+def test_trace_exporter_tiny_smoke(capsys):
+    """The Chrome-trace exporter's --tiny: synthesize a journal, export
+    it, and assert the expand/commit lanes landed in the JSON."""
+    from jaxtlc.obs import trace as obs_trace
+
+    assert obs_trace.main(["--tiny"]) == 0
+    out = capsys.readouterr().out
+    assert "trace-export tiny OK" in out
+
+
+# ---- bench payload contract (ISSUE 5 satellite) --------------------------
+
+
+REQUIRED_PAYLOAD_FIELDS = ("metric", "value", "unit", "vs_baseline")
+
+
+def test_bench_emit_enforces_payload_contract(capsys):
+    """Every line bench.py emits goes through the journal-validated
+    payload view: required fields are always present (base-filled), and
+    the line doubles as a schema-checked bench_metric event."""
+    spec = importlib.util.spec_from_file_location(
+        "bench",
+        os.path.join(os.path.dirname(__file__), os.pardir, "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._emit({"metric": "x_per_s", "value": 1.5, "unit": "x/s",
+                 "workload": "FF"})
+    bench._emit({"error": "deliberate"})  # failure payloads too
+    lines = capsys.readouterr().out.strip().splitlines()
+    for line in lines:
+        payload = json.loads(line)
+        for field in REQUIRED_PAYLOAD_FIELDS:
+            assert field in payload, f"payload lost {field!r}: {payload}"
+        assert "pipeline" in payload
+    # both emissions were journaled as validated bench_metric events
+    kinds = [e["event"] for e in bench._JOURNAL.events]
+    assert kinds.count("bench_metric") == 2
+
+
+def test_committed_bench_payloads_have_required_fields():
+    """The committed BENCH_*.json history (driver wrappers whose
+    `parsed` member is the bench payload line) must satisfy the same
+    contract the emitter now enforces."""
+    root = os.path.join(os.path.dirname(__file__), os.pardir)
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+    assert paths, "no committed BENCH_*.json payloads found"
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        payload = doc.get("parsed")
+        if payload is None:  # a failed round records no payload
+            continue
+        for field in REQUIRED_PAYLOAD_FIELDS:
+            assert field in payload, (
+                f"{os.path.basename(path)} payload lost {field!r}: "
+                f"{payload}"
+            )

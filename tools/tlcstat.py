@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""tlcstat: one-screen live dashboard over a jaxtlc run journal.
+
+Tails the append-only JSONL journal a run writes (`-journal PATH`, or
+`CKPT.journal.jsonl` beside a `-checkpoint`) and renders the numbers an
+operator actually wants mid-run: current depth, generated/distinct with
+interval rates (the same arithmetic as the TLC 2200 Progress line -
+obs.views.interval_rates is shared, so they cannot disagree), queue
+depth, fingerprint-table occupancy, a queue-drain ETA, recovery-event
+counts, and the last journal event.
+
+    python tools/tlcstat.py RUN.journal.jsonl            # one frame
+    python tools/tlcstat.py RUN.journal.jsonl --follow   # live tail
+    python tools/tlcstat.py --tiny                       # tier-1 smoke
+
+The dashboard is a pure view of the journal - it opens the file
+read-only and never blocks the writer (per-event fsync appends are
+atomic at line granularity; a torn trailing line is skipped).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+)
+
+from jaxtlc.obs import journal as jr  # noqa: E402
+from jaxtlc.obs.schema import SCHEMA_VERSION  # noqa: E402
+from jaxtlc.obs.views import eta_s, interval_rates  # noqa: E402
+
+
+def _fmt_eta(s) -> str:
+    if s is None:
+        return "-"
+    if s < 90:
+        return f"{s:.0f}s"
+    if s < 5400:
+        return f"{s / 60:.1f}m"
+    return f"{s / 3600:.1f}h"
+
+
+def _last_two(events, kinds):
+    """(previous, latest) events of the given kinds (None-padded)."""
+    hits = [e for e in events if e["event"] in kinds]
+    if not hits:
+        return None, None
+    return (hits[-2] if len(hits) > 1 else None), hits[-1]
+
+
+def render(events) -> str:
+    """One dashboard frame from a journal event list."""
+    if not events:
+        return "tlcstat: journal is empty (run not started yet?)"
+    manifest = next(
+        (e for e in events if e["event"] == "run_start"), None
+    )
+    lines = []
+    if manifest is not None:
+        p = manifest.get("params", {})
+        lines.append(
+            f"jaxtlc {manifest['version']}  |  {manifest['workload']} "
+            f"({manifest['engine']} engine)  |  {manifest['device']}"
+        )
+        lines.append(
+            f"journal schema v{events[0]['v']} (reader v{SCHEMA_VERSION})"
+            f"  chunk={p.get('chunk', '?')}"
+            f"  fp_capacity={p.get('fp_capacity', '?')}"
+            f"  pipeline={p.get('pipeline', False)}"
+            f"  obs_slots={p.get('obs_slots', 0)}"
+        )
+    # progress source: level events (per-level resolution) when the
+    # device ring is on, progress events otherwise
+    prev, cur = _last_two(events, ("level",))
+    if cur is None:
+        prev, cur = _last_two(events, ("progress",))
+    if cur is not None:
+        spm, dpm = interval_rates(
+            (prev["t"], prev["generated"], prev["distinct"])
+            if prev is not None else None,
+            cur["t"], cur["generated"], cur["distinct"],
+        )
+        depth = cur.get("level", cur.get("depth", "?"))
+        lines.append(
+            f"depth {depth}  |  {cur['generated']:,} generated "
+            f"({spm:,} s/min)  |  {cur['distinct']:,} distinct "
+            f"({dpm:,} ds/min)"
+        )
+        occ = cur.get("fp_load")
+        lines.append(
+            f"queue {cur['queue']:,}"
+            + (f"  |  fp table {occ:.1%} full" if occ is not None else "")
+            + f"  |  ETA (queue drain) {_fmt_eta(eta_s(prev, cur))}"
+        )
+    counts = {}
+    for e in events:
+        counts[e["event"]] = counts.get(e["event"], 0) + 1
+    lines.append(
+        f"segments {counts.get('segment', 0)}"
+        f"  checkpoints {counts.get('checkpoint', 0)}"
+        f"  regrows {counts.get('regrow', 0)}"
+        f"  retries {counts.get('retry', 0)}"
+        f"  interruptions {counts.get('interrupted', 0)}"
+    )
+    last = events[-1]
+    age = time.time() - last["t"]
+    lines.append(f"last event: {last['event']} ({age:.1f}s ago)")
+    fin = next((e for e in reversed(events) if e["event"] == "final"),
+               None)
+    if fin is not None:
+        lines.append(
+            f"VERDICT: {fin['verdict']}  -  {fin['generated']:,} "
+            f"generated, {fin['distinct']:,} distinct, depth "
+            f"{fin['depth']}, wall {fin['wall_s']:.3f}s"
+        )
+    width = max(len(x) for x in lines)
+    bar = "=" * min(width, 78)
+    return "\n".join([bar, *lines, bar])
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tlcstat")
+    p.add_argument("journal", nargs="?", help="run journal (JSONL)")
+    p.add_argument("--follow", action="store_true",
+                   help="re-render as the journal grows (ctrl-c exits)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="follow-mode refresh seconds")
+    p.add_argument("--tiny", action="store_true",
+                   help="smoke: render a synthetic journal end-to-end "
+                        "(no engine run; wired into tier-1)")
+    args = p.parse_args(argv)
+
+    if args.tiny:
+        import tempfile
+
+        from jaxtlc.obs.trace import _tiny_journal
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "tiny.journal.jsonl")
+            _tiny_journal(path)
+            frame = render(jr.read(path))
+        assert "VERDICT: interrupted" in frame and "ds/min" in frame
+        print(frame)
+        print("tlcstat tiny OK")
+        return 0
+
+    if not args.journal:
+        p.error("journal path required (or --tiny)")
+    if not os.path.exists(args.journal):
+        print(f"tlcstat: no journal at {args.journal!r}",
+              file=sys.stderr)
+        return 1
+    if not args.follow:
+        print(render(jr.read(args.journal, validate=False)))
+        return 0
+    try:
+        while True:
+            frame = render(jr.read(args.journal, validate=False))
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
