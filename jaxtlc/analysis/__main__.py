@@ -1,0 +1,92 @@
+"""``python -m jaxtlc.analysis`` - the standalone preflight runner.
+
+    python -m jaxtlc.analysis path/to/MC.cfg [--deep] [--journal PATH]
+    python -m jaxtlc.analysis --self-check [--tiny]
+
+The first form runs the preflight suite on a model (the same pass the
+CLI runs before a check) and prints the full report; the second audits
+every shipped engine factory (selfcheck.FACTORIES).  Exit status: 0
+clean or warnings only, nonzero on error-severity findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m jaxtlc.analysis")
+    p.add_argument("config", nargs="?", default="",
+                   help="path to MC.cfg (preflight that model)")
+    p.add_argument("--deep", action="store_true",
+                   help="also trace the engine jaxpr (purity audit); "
+                        "tracing only, never an XLA compile")
+    p.add_argument("--journal", default="", metavar="PATH",
+                   help="append the findings as schema-validated "
+                        "`analysis` events to PATH")
+    p.add_argument("--self-check", action="store_true",
+                   dest="self_check",
+                   help="audit every shipped engine factory (fused, "
+                        "pipelined, sharded, struct, enumerator)")
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny geometries (the tier-1 smoke mode)")
+    args = p.parse_args(argv)
+
+    if args.self_check:
+        from .selfcheck import self_check
+
+        report = self_check(tiny=args.tiny)
+        _journal(args, report)
+        if report.findings:
+            from .report import print_report
+
+            print_report(report)
+        return report.exit_code
+
+    if not args.config:
+        p.print_usage(sys.stderr)
+        print("error: an MC.cfg path or --self-check is required",
+              file=sys.stderr)
+        return 2
+
+    from ..frontend.model import GenRunSpec, StructRunSpec, resolve
+
+    try:
+        spec = resolve(args.config)
+    except (ValueError, OSError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 2
+    from .preflight import preflight_gen, preflight_kubeapi, preflight_struct
+    from .report import print_report
+
+    sizes = dict(fp_capacity=1 << 20, chunk=1024,
+                 queue_capacity=1 << 15)
+    if isinstance(spec, StructRunSpec):
+        report = preflight_struct(
+            spec.structmodel, deep=args.deep,
+            check_deadlock=spec.check_deadlock, **sizes,
+        )
+    elif isinstance(spec, GenRunSpec):
+        report = preflight_gen(spec.genspec,
+                               fp_capacity=sizes["fp_capacity"],
+                               deep=args.deep)
+    else:
+        report = preflight_kubeapi(spec.model, deep=args.deep, **sizes)
+    print_report(report)
+    _journal(args, report)
+    return report.exit_code
+
+
+def _journal(args, report) -> None:
+    if not args.journal:
+        return
+    from ..obs.journal import RunJournal
+    from .report import emit_to_journal
+
+    with RunJournal(args.journal, resume=True) as j:
+        emit_to_journal(j, report)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Make use-after-donate loud on CPU (JAXTLC_DEBUG_DONATION=1).
+
+`make_backend_engine(donate=True)` donates the carry on device
+backends; on CPU XLA has no donation, so a driver that wrongly feeds
+the same carry twice works on CPU and corrupts on TPU - the exact
+hazard class the engine-layer donation audit flags statically
+(analysis.engine_audit).  This module is the RUNTIME teeth: with
+``JAXTLC_DEBUG_DONATION=1`` (on in the test suite, tests/conftest.py) a
+factory that REQUESTED donation wraps its run/step functions so the
+input carry's buffers are deleted after each call - reuse then raises
+``RuntimeError: Array has been deleted`` immediately, at the reuse
+site, on any backend.
+
+Leaves that the jit returns by identity (pass-through outputs share the
+input Array object) are skipped, so poisoning never deletes a buffer
+the caller legitimately holds through the RESULT.  AOT paths
+(`fn.lower(carry).compile()`) bypass the wrapper - they also bypass the
+donation request on CPU, so there is nothing to simulate there.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def debug_donation_enabled() -> bool:
+    return os.environ.get("JAXTLC_DEBUG_DONATION", "") not in (
+        "", "0", "false", "off"
+    )
+
+
+def _poison(carry, out) -> None:
+    import jax
+
+    keep = {id(x) for x in jax.tree_util.tree_leaves(out)}
+    for leaf in jax.tree_util.tree_leaves(carry):
+        if isinstance(leaf, jax.Array) and id(leaf) not in keep:
+            try:
+                leaf.delete()
+            except Exception:
+                pass  # already deleted / committed elsewhere: fine
+
+
+class PoisoningFn:
+    """Callable wrapper simulating donation semantics: after `fn(carry)`
+    the input carry is dead.  All other attribute access (``.lower``,
+    the donation tags) forwards to the wrapped function."""
+
+    def __init__(self, fn):
+        self._inner = fn
+
+    def __call__(self, carry):
+        out = self._inner(carry)
+        _poison(carry, out)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def wrap_if_debugging(fn, donate_requested: bool):
+    """Apply the poisoning wrapper when the debug mode is on AND the
+    factory asked for donation (a donate=False engine must stay safe to
+    reuse - the supervisor's retry loop depends on it)."""
+    if donate_requested and debug_donation_enabled():
+        return PoisoningFn(fn)
+    return fn

@@ -1,0 +1,158 @@
+"""Audit every shipped engine factory (the preflight's own CI).
+
+`python -m jaxtlc.analysis --self-check --tiny` builds each production
+engine factory at tiny geometry, traces its run/step jaxprs and runs
+the engine-layer audit suite (purity, donation tags, counter widths).
+The registry below IS the definition of "shipped": a new engine path
+added without a registry entry fails the tier-1 smoke test
+(tests/test_analysis.py pins the factory list), so no engine can ship
+unaudited.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from . import AnalysisReport, Finding
+from .engine_audit import audit_engine, carry_shapes
+
+# tiny self-check geometry: enough rows for the FF inits, nothing more
+_TINY = dict(chunk=16, queue_capacity=1 << 8, fp_capacity=1 << 10)
+
+
+def _ff_backend():
+    from ..config import ModelConfig
+    from ..engine.backend import kubeapi_backend
+
+    return kubeapi_backend(ModelConfig(False, False))
+
+
+def _build_fused():
+    from ..engine.bfs import make_backend_engine
+
+    init_fn, run_fn, step_fn = make_backend_engine(
+        _ff_backend(), donate=False, **_TINY
+    )
+    return dict(init_fn=init_fn, run_fn=run_fn, step_fn=step_fn,
+                n_lanes=_ff_backend().n_lanes,
+                fp_capacity=_TINY["fp_capacity"])
+
+
+def _build_pipelined():
+    from ..engine.bfs import make_backend_engine
+
+    init_fn, run_fn, step_fn = make_backend_engine(
+        _ff_backend(), donate=False, pipeline=True, **_TINY
+    )
+    return dict(init_fn=init_fn, run_fn=run_fn, step_fn=step_fn,
+                n_lanes=_ff_backend().n_lanes,
+                fp_capacity=_TINY["fp_capacity"])
+
+
+def _build_sharded():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..engine.sharded import make_sharded_engine
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("fp",))
+    init_fn, run_fn = make_sharded_engine(
+        None, mesh, backend=_ff_backend(), **_TINY
+    )
+    return dict(init_fn=init_fn, run_fn=run_fn,
+                n_lanes=_ff_backend().n_lanes,
+                fp_capacity=_TINY["fp_capacity"])
+
+
+def _specs_dir() -> Optional[str]:
+    import os
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cand = os.path.join(os.path.dirname(here), "specs")
+    return cand if os.path.isdir(cand) else None
+
+
+def _build_struct():
+    import os
+
+    from ..engine.bfs import make_backend_engine
+    from ..struct.cache import get_backend
+    from ..struct.loader import load
+
+    d = _specs_dir()
+    if d is None:
+        raise FileNotFoundError("specs/ directory not found")
+    model = load(os.path.join(d, "TwoPhase.toolbox", "Model_1",
+                              "MC.cfg"))
+    b = get_backend(model, True)
+    init_fn, run_fn, step_fn = make_backend_engine(
+        b, donate=False, **_TINY
+    )
+    return dict(init_fn=init_fn, run_fn=run_fn, step_fn=step_fn,
+                n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
+
+
+def _build_enumerator():
+    from ..engine.bfs import make_enumerator
+
+    init_fn, run_fn = make_enumerator(
+        _ff_backend(), chunk=16, state_capacity=1 << 10,
+        fp_capacity=1 << 10,
+    )
+    return dict(init_fn=init_fn, run_fn=run_fn,
+                n_lanes=_ff_backend().n_lanes, fp_capacity=1 << 10)
+
+
+# every shipped engine factory; audited by the self-check and pinned
+# by tier-1 so a new engine path cannot ship unaudited
+FACTORIES: Dict[str, Callable[[], dict]] = {
+    "fused": _build_fused,
+    "pipelined": _build_pipelined,
+    "sharded": _build_sharded,
+    "struct": _build_struct,
+    "enumerator": _build_enumerator,
+}
+
+
+def self_check(tiny: bool = True, out=None) -> AnalysisReport:
+    """Build + audit every registered factory.  `tiny` is accepted for
+    CLI symmetry; the registry always builds tiny geometries (the audit
+    is geometry-independent - jaxprs, not runs)."""
+    import sys
+    import time
+
+    out = out or sys.stdout
+    t0 = time.time()
+    report = AnalysisReport(name="self-check")
+    for name in sorted(FACTORIES):
+        try:
+            built = FACTORIES[name]()
+        except FileNotFoundError as e:
+            out.write(f"audit {name}: SKIPPED ({e})\n")
+            continue
+        carry = carry_shapes(built["init_fn"])
+        findings: List[Finding] = audit_engine(
+            name,
+            built["init_fn"],
+            built.get("run_fn"),
+            built.get("step_fn"),
+            reuses_carry=built.get("reuses_carry", False),
+            fp_capacity=built.get("fp_capacity"),
+            n_lanes=built.get("n_lanes"),
+            trace=True,
+            carry=carry,
+        )
+        report.extend(findings)
+        status = "ok" if not findings else (
+            f"{len(findings)} finding(s)"
+        )
+        out.write(f"audit {name}: {status}\n")
+        report.engine_lines.append(f"{name}: {status}")
+    report.wall_s = time.time() - t0
+    out.write(
+        f"self-check: {len(FACTORIES)} factories, "
+        f"{len(report.findings)} finding(s), "
+        f"{report.wall_s:.2f}s\n"
+    )
+    return report

@@ -96,6 +96,18 @@ def _run_check(args) -> int:
                     pipeline=args.pipeline,
                     obs_slots=_obs_slots(args)),
     )
+
+    def _kubeapi_preflight(deep):
+        from .analysis.preflight import preflight_kubeapi
+
+        return preflight_kubeapi(
+            spec.model, fp_capacity=args.fpcap, chunk=args.chunk,
+            queue_capacity=args.qcap, deep=deep,
+        )
+
+    rc = _preflight_gate(args, log, _kubeapi_preflight)
+    if rc is not None:
+        return rc
     t0 = time.time()
     from .resil import SlotOverflowError
 
@@ -338,6 +350,49 @@ def _dispatch_check(args, spec, log):
         pipeline=args.pipeline,
         obs_slots=_obs_slots(args),
     ), None
+
+
+def _preflight_gate(args, log, build_report):
+    """Run the preflight suite before a check (ISSUE 6 pipeline).
+
+    -no-preflight skips entirely; -analyze runs the deep mode (adds
+    the engine jaxpr purity trace - tracing only, no XLA compile).
+    Findings journal as schema-validated `analysis` events and render
+    as TLC-style warning banners (derived views of the same events, so
+    they cannot disagree); a clean preflight is silent.  Returns the
+    nonzero exit code on error-severity findings, None to proceed."""
+    if not args.preflight:
+        return None
+    from .analysis.report import emit_to_journal
+    from .obs.views import render_tlc_event
+
+    try:
+        report = build_report(args.analyze)
+    except Exception as e:  # a broken lint must never block a run
+        log.msg(1000, f"Preflight analysis skipped: {e}", severity=1)
+        return None
+    journal = getattr(args, "_journal", None)
+
+    def on_event(kind, info):
+        import time as _time
+
+        from .obs.schema import SCHEMA_VERSION
+
+        render_tlc_event(log, {"v": SCHEMA_VERSION, "t": _time.time(),
+                               "event": kind, **info})
+
+    emit_to_journal(journal, report, on_event=on_event)
+    if report.errors:
+        if journal is not None:
+            journal.event("final", verdict="error", generated=0,
+                          distinct=0, depth=0, queue=0, wall_s=0.0,
+                          interrupted=False)
+        log.msg(1000, "Preflight analysis found error-severity "
+                      "findings; run aborted (-no-preflight to "
+                      "override).", severity=1)
+        _finish_journal(args, log)
+        return report.exit_code
+    return None
 
 
 def _sup_opts(args, log):
@@ -614,8 +669,15 @@ def _run_check_gen(args, spec) -> int:
             g, check_deadlock=spec.check_deadlock
         ),
         coverage=lambda: _gen_coverage_lines(spec, g),
+        preflight=lambda deep: _gen_preflight(args, g, deep),
     )
     return _run_check_interp(args, spec, kit)
+
+
+def _gen_preflight(args, g, deep):
+    from .analysis.preflight import preflight_gen
+
+    return preflight_gen(g, fp_capacity=args.fpcap, deep=deep)
 
 
 def _gen_coverage_lines(spec, g):
@@ -739,8 +801,26 @@ def _run_check_struct(args, spec) -> int:
             system, sm.invariants, check_deadlock=spec.check_deadlock
         ),
         action_order=action_order,
+        preflight=lambda deep: _struct_preflight(args, spec, sm, deep),
     )
     return _run_check_interp(args, spec, kit, log_holder=log_holder)
+
+
+def _struct_preflight(args, spec, sm, deep):
+    from .analysis.preflight import preflight_struct
+
+    backend = None
+    if deep:
+        # the same memoized backend the run is about to use: the deep
+        # audit adds a jaxpr trace, never a second lane compile
+        from .struct.cache import get_backend
+
+        backend = get_backend(sm, spec.check_deadlock)
+    return preflight_struct(
+        sm, fp_capacity=args.fpcap, chunk=args.chunk,
+        queue_capacity=args.qcap, check_deadlock=spec.check_deadlock,
+        deep=deep, backend=backend,
+    )
 
 
 class _InterpKit:
@@ -750,7 +830,7 @@ class _InterpKit:
     def __init__(self, kind, extra_unsupported, check, init_count,
                  properties, check_leads_to, fairness_label,
                  state_to_tla, state_env, violation_trace,
-                 coverage=None, action_order=None):
+                 coverage=None, action_order=None, preflight=None):
         self.kind = kind
         self.extra_unsupported = extra_unsupported
         self.check = check  # () -> (CheckResult, SupervisedResult | None)
@@ -763,6 +843,7 @@ class _InterpKit:
         self.violation_trace = violation_trace
         self.coverage = coverage  # () -> dump lines, or None
         self.action_order = action_order  # () -> coverage line order
+        self.preflight = preflight  # (deep) -> AnalysisReport, or None
 
 
 def _run_check_interp(args, spec, kit: "_InterpKit",
@@ -805,6 +886,10 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
                     pipeline=args.pipeline, frontend=kit.kind,
                     obs_slots=_obs_slots(args)),
     )
+    if kit.preflight is not None:
+        rc = _preflight_gate(args, log, kit.preflight)
+        if rc is not None:
+            return rc
     t0 = time.time()
     from .resil import SlotOverflowError
 
@@ -1094,6 +1179,20 @@ def main(argv=None) -> int:
                    help="wrap the check in a jax.profiler trace writing "
                         "to DIR (the ground-truth device timeline; "
                         "view with TensorBoard/XProf)")
+    c.add_argument("-analyze", action="store_true",
+                   help="deep preflight: in addition to the default "
+                        "spec-IR lints and counter-width arithmetic, "
+                        "trace the engine jaxpr and audit hot-body "
+                        "purity and donation safety (tracing only - "
+                        "no extra XLA compile; python -m "
+                        "jaxtlc.analysis runs the same suite "
+                        "standalone)")
+    c.add_argument("-no-preflight", dest="preflight",
+                   action="store_false", default=True,
+                   help="skip the preflight analysis suite (the "
+                        "escape hatch when a lint is wrong; error-"
+                        "severity findings otherwise abort the run "
+                        "with a nonzero exit)")
     c.add_argument("-coverage", action="store_true",
                    help="emit the full per-expression coverage dump "
                         "(TLC coverage mode; re-walks the space host-side)")

@@ -254,7 +254,13 @@ def make_backend_engine(
     bit-for-bit those of an obs-off run (bench.py --obs-ab gates the
     wall-clock overhead at <= 2%).
     """
-    from ..obs.counters import pack_row, ring_new, ring_update
+    from ..obs.counters import (
+        pack_row,
+        ring_new,
+        ring_update,
+        sticky_overflow,
+        wrapped_any,
+    )
     from .backend import ExpandOut, make_expand_stage
 
     assert 0.0 < fp_highwater <= 1.0, "fp_highwater must be in (0, 1]"
@@ -526,13 +532,22 @@ def make_backend_engine(
             if obs_slots:
                 # one telemetry row per completed level (post-commit
                 # cumulative counters; the dump row absorbs non-flip
-                # bodies so the store is unconditional)
+                # bodies so the store is unconditional).  The sticky
+                # COL_OVERFLOW flag marks any uint32 wrap so saturated
+                # counters are detected, never silently wrong
                 obs_bodies = c.obs_bodies + jnp.uint32(1)
                 obs_expanded = c.obs_expanded + n.astype(jnp.uint32)
+                wrapped = wrapped_any([
+                    (generated, c.generated), (distinct, c.distinct),
+                    (act_gen, c.act_gen), (act_dist, c.act_dist),
+                    (obs_bodies, c.obs_bodies),
+                    (obs_expanded, c.obs_expanded),
+                ])
                 row = pack_row(
                     c.level, generated, distinct, level_n, obs_bodies,
                     obs_expanded, act_gen[:n_labels],
                     act_dist[:n_labels],
+                    overflow=sticky_overflow(c.obs_ring, wrapped),
                 )
                 ring, head = ring_update(
                     c.obs_ring, c.obs_head, row, level_done
@@ -652,6 +667,20 @@ def make_backend_engine(
     step_fn = jax.jit(
         lambda c: lax.cond(cond(c), body, lambda x: x, c), **jit_kw
     )
+    # donation metadata for the preflight audit (analysis.engine_audit):
+    # donate_requested is the factory intent, donates_carry what XLA
+    # will actually do on this platform - the gap is the class of bug
+    # that only reproduces on device
+    for fn in (run_fn, step_fn):
+        fn.donate_requested = bool(donate)
+        fn.donates_carry = donate_ok
+    # JAXTLC_DEBUG_DONATION=1: simulate donation semantics on every
+    # backend by poisoning the input carry after each call, so a
+    # use-after-donate fails fast on CPU instead of only on TPU
+    from ..analysis.donation import wrap_if_debugging
+
+    run_fn = wrap_if_debugging(run_fn, bool(donate))
+    step_fn = wrap_if_debugging(step_fn, bool(donate))
     return init_fn, run_fn, step_fn
 
 
@@ -858,10 +887,15 @@ def make_enumerator(
         if obs_slots:
             # one row per body (the enumerator has no levels): distinct
             # doubles as generated-distinct, queue = unexpanded backlog
+            from ..obs.counters import sticky_overflow, wrapped_any
+
             zeros = jnp.zeros(n_labels, jnp.uint32)
+            wrapped = wrapped_any([(tail.astype(jnp.uint32),
+                                    c.tail.astype(jnp.uint32))])
             row = pack_row(
                 jnp.int32(0), tail, tail, tail - (c.head + n),
                 c.obs_head + 1, c.head + n, zeros, zeros,
+                overflow=sticky_overflow(c.obs_ring, wrapped),
             )
             ring, rhead = ring_update(
                 c.obs_ring, c.obs_head, row, jnp.bool_(True)

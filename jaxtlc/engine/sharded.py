@@ -183,7 +183,13 @@ def make_sharded_engine(
     chunk (the deferred verdict exchange); cumulative totals catch up
     at the next row.
     """
-    from ..obs.counters import pack_row, ring_cols, ring_update
+    from ..obs.counters import (
+        pack_row,
+        ring_cols,
+        ring_update,
+        sticky_overflow,
+        wrapped_any,
+    )
     (axis,) = mesh.axis_names
     D = mesh.devices.size
     assert D & (D - 1) == 0, "device count must be a power of two"
@@ -488,9 +494,17 @@ def make_sharded_engine(
             # lock-step); non-flip bodies write the dump row
             obs_bodies = c.obs_bodies[0] + jnp.uint32(1)
             obs_expanded = c.obs_expanded[0] + n.astype(jnp.uint32)
+            wrapped = wrapped_any([
+                (generated, c.generated[0]),
+                (distinct, c.distinct[0]),
+                (act_gen, c.act_gen[0]),
+                (obs_bodies, c.obs_bodies[0]),
+                (obs_expanded, c.obs_expanded[0]),
+            ])
             row = pack_row(
                 level, generated, distinct, qtail - qhead, obs_bodies,
                 obs_expanded, act_gen[:n_labels], act_dist[:n_labels],
+                overflow=sticky_overflow(c.obs_ring[0], wrapped),
             )
             ring, rhead = ring_update(
                 c.obs_ring[0], c.obs_head[0], row, adv & level_done

@@ -23,7 +23,12 @@ accuracy):
     col 3  queue      width of the NEXT level (states left on queue)
     col 4  bodies     engine loop bodies executed so far
     col 5  expanded   states popped/expanded so far
-    col 6  reserved
+    col 6  overflow   STICKY saturation flag: 1 once any cumulative
+                      uint32 column wrapped (new < old between bodies);
+                      decoded as a `counter_overflow` warning so
+                      saturated counters are detected, never silently
+                      wrong (the jaxtlc.analysis counter-width audit
+                      flags the risky configs before the run)
     col 7  reserved
     col 8..8+A-1      per-action generated (cumulative)
     col 8+A..8+2A-1   per-action distinct  (cumulative)
@@ -43,7 +48,8 @@ DEFAULT_OBS_SLOTS = 256
 
 N_FIXED_COLS = 8
 (COL_LEVEL, COL_GENERATED, COL_DISTINCT, COL_QUEUE, COL_BODIES,
- COL_EXPANDED, COL_RES0, COL_RES1) = range(N_FIXED_COLS)
+ COL_EXPANDED, COL_OVERFLOW, COL_RES1) = range(N_FIXED_COLS)
+COL_RES0 = COL_OVERFLOW  # pre-overflow name of col 6
 
 
 def ring_cols(n_labels: int) -> int:
@@ -77,19 +83,45 @@ def ring_update(ring, head, row, flip):
 
 
 def pack_row(level, generated, distinct, queue, bodies, expanded,
-             act_gen, act_dist):
-    """Assemble one ring row from carry scalars (device-side)."""
+             act_gen, act_dist, overflow=None):
+    """Assemble one ring row from carry scalars (device-side).
+    `overflow` is the sticky uint32 saturation flag (COL_OVERFLOW);
+    None writes 0 (engines that predate the flag)."""
     import jax.numpy as jnp
 
     u = jnp.uint32
     fixed = jnp.stack([
         level.astype(u), generated.astype(u), distinct.astype(u),
         queue.astype(u), bodies.astype(u), expanded.astype(u),
-        u(0), u(0),
+        u(0) if overflow is None else overflow.astype(u), u(0),
     ])
     return jnp.concatenate(
         [fixed, act_gen.astype(u), act_dist.astype(u)]
     )
+
+
+def sticky_overflow(ring, wrapped):
+    """The sticky saturation flag for the row about to be written:
+    1 once ANY past row recorded an overflow (the flag never unsets,
+    so the max over the whole ring - dump row included - is exactly
+    "ever wrapped") OR a cumulative counter wrapped this body.
+    `wrapped` is a device bool; returns uint32."""
+    import jax.numpy as jnp
+
+    prev = ring[:, COL_OVERFLOW].max()
+    return jnp.maximum(prev, wrapped.astype(jnp.uint32))
+
+
+def wrapped_any(pairs):
+    """Device bool: any (new, old) cumulative uint32 pair wrapped this
+    body (new < old is impossible for a monotone counter except via
+    2^32 wrap-around)."""
+    import jax.numpy as jnp
+
+    out = jnp.bool_(False)
+    for new, old in pairs:
+        out = out | (new < old).any()
+    return out
 
 
 def rows_from_ring(
@@ -120,6 +152,10 @@ def rows_from_ring(
         }
         if fp_capacity:
             row["fp_load"] = round(int(r[COL_DISTINCT]) / fp_capacity, 6)
+        if r[COL_OVERFLOW]:
+            # sticky device-side saturation flag: totals in this row
+            # (and every later one) may have wrapped uint32
+            row["counter_overflow"] = True
         if labels is not None:
             a = len(labels)
             gen = r[N_FIXED_COLS:N_FIXED_COLS + a]

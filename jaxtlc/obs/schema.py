@@ -70,6 +70,14 @@ EVENTS = {
     "final": {"verdict": _STR, "generated": _NUM, "distinct": _NUM,
               "depth": _NUM, "queue": _NUM, "wall_s": _NUM,
               "interrupted": _BOOL},
+    # -- preflight analysis (jaxtlc.analysis) ------------------------------
+    # one event per finding, severity in ("error", "warning", "info")
+    "analysis": {"layer": _STR, "check": _STR, "severity": _STR,
+                 "subject": _STR, "detail": _STR},
+    # one per preflight run: the banner-level totals
+    "analysis_summary": {"name": _STR, "findings": _NUM,
+                         "errors": _NUM, "warnings": _NUM,
+                         "wall_s": _NUM},
     # -- derived artifacts -------------------------------------------------
     "trace_export": {"path": _STR, "events": _NUM},
     # one bench.py metric payload (the BENCH_*.json line contract)

@@ -65,6 +65,35 @@ def render_tlc_event(log, ev: dict, resume_cmd: str = "") -> None:
     if kind == "progress":
         log.progress(ev["depth"], ev["generated"], ev["distinct"],
                      ev["queue"])
+    elif kind == "analysis":
+        log.msg(
+            1000,
+            f"Preflight {ev['severity']} "
+            f"[{ev['layer']}/{ev['check']}] {ev['subject']}: "
+            f"{ev['detail']}",
+            severity=1,
+        )
+    elif kind == "analysis_summary":
+        if ev["findings"]:
+            log.msg(
+                1000,
+                f"Preflight analysis: {ev['errors']} error(s), "
+                f"{ev['warnings']} warning(s) "
+                f"({ev['findings']} finding(s) total).",
+                severity=1,
+            )
+    elif kind == "level" and ev.get("counter_overflow"):
+        # the ring's sticky COL_OVERFLOW flag: warn once per run (the
+        # flag never unsets, so every later level row carries it too)
+        if not getattr(log, "_warned_counter_overflow", False):
+            log._warned_counter_overflow = True
+            log.msg(
+                1000,
+                "Warning: on-device cumulative uint32 counters "
+                "saturated (ring overflow flag set); generated/"
+                "distinct totals beyond this level may have wrapped.",
+                severity=1,
+            )
     elif kind == "checkpoint":
         log.checkpoint_saved(ev["path"])
     elif kind == "recovery":

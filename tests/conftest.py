@@ -14,6 +14,12 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
+# make use-after-donate loud on CPU: engines built with donate=True
+# poison their input carry after every run/step call, so feeding the
+# same carry twice fails HERE instead of corrupting a TPU run
+# (jaxtlc.analysis.donation; ISSUE 6 satellite)
+os.environ.setdefault("JAXTLC_DEBUG_DONATION", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
