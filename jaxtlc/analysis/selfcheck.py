@@ -104,12 +104,31 @@ def _build_enumerator():
                 n_lanes=_ff_backend().n_lanes, fp_capacity=1 << 10)
 
 
+def _build_spill():
+    # the spill-capable engine: the DEVICE composition (expand +
+    # fpset_member filter + veto commit) is traced as one step; the
+    # host probe sits between the two jits in production, outside any
+    # device body, which is exactly what the purity audit verifies
+    from ..engine.spill import SpillRuntime, SpillStore
+
+    rt = SpillRuntime(
+        _ff_backend(), chunk=_TINY["chunk"],
+        queue_capacity=_TINY["queue_capacity"],
+        fp_capacity=_TINY["fp_capacity"],
+        store=SpillStore(1 << 10),
+    )
+    return dict(init_fn=rt.init_fn, step_fn=rt.audit_step_fn,
+                n_lanes=_ff_backend().n_lanes,
+                fp_capacity=_TINY["fp_capacity"])
+
+
 # every shipped engine factory; audited by the self-check and pinned
 # by tier-1 so a new engine path cannot ship unaudited
 FACTORIES: Dict[str, Callable[[], dict]] = {
     "fused": _build_fused,
     "pipelined": _build_pipelined,
     "sharded": _build_sharded,
+    "spill": _build_spill,
     "struct": _build_struct,
     "enumerator": _build_enumerator,
 }

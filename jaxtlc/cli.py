@@ -15,15 +15,22 @@ counterexample trace and prints it TLC-style with PlusCal action labels.
 
 Exit codes: 0 = no error; 12 = safety violation (TLC's EC.ExitStatus
 convention for violations); 13 = liveness violation; 75 = interrupted
-(SIGTERM/SIGINT) with a final checkpoint written - resume with -recover;
+(SIGTERM/SIGINT) OR capacity-exhausted (the degradation ladder's final
+rung) with a final checkpoint written - resume with -recover;
 1 = usage/config error (including non-regrowable codec slot overflow).
 
 Robustness (the resil supervisor wraps the KubeAPI-path engines):
--auto-grow (default) doubles a saturated fpset/queue/route resource,
-migrates the carry, and resumes instead of aborting; -retry N retries
-segments around transient device errors; -checkpoint writes CRC-verified
-generation-numbered snapshots and -recover loads the newest intact one
-(auto-grown geometry travels inside the checkpoint).
+capacity exhaustion walks a degradation ladder instead of aborting -
+-auto-grow (default) doubles a saturated fpset/queue/route resource
+after a probe allocation confirms it fits; when the probe is denied,
+-spill (default auto) activates the host-RAM fingerprint spill tier so
+the run completes inside the device memory it has; then chunk shrink;
+then checkpoint + exit 75.  -retry N retries segments around transient
+device errors (RESOURCE_EXHAUSTED is classified as deterministic and
+goes to the ladder, never the retry budget); -checkpoint writes
+CRC-verified generation-numbered snapshots (spilling runs pair each
+with a host-tier .spill sibling) and -recover loads the newest intact
+one (auto-grown geometry and the host tier travel with the checkpoint).
 """
 
 from __future__ import annotations
@@ -427,6 +434,7 @@ def _sup_opts(args, log):
         ckpt_path=args.checkpoint or None,
         ckpt_every=args.checkpointevery,
         resume=args.recover,
+        spill=args.spill,
         faults=FaultPlan.parse(args.faults) if args.faults else None,
         on_event=on_event,
     )
@@ -1121,6 +1129,22 @@ def main(argv=None) -> int:
                         "with the sizing hint (the pre-supervisor "
                         "behavior); without -checkpoint this also "
                         "restores the raw fused single-dispatch engine")
+    c.add_argument("-spill", dest="spill", action="store_const",
+                   const="on", default="auto",
+                   help="prefer the host-RAM fingerprint spill tier at "
+                        "the FIRST fpset saturation (skip the regrow "
+                        "attempt).  Default auto: regrow first, spill "
+                        "when the doubled table's probe allocation is "
+                        "denied (RESOURCE_EXHAUSTED) or -max-regrow is "
+                        "reached.  Cold fingerprints migrate to a host "
+                        "store behind an on-device membership filter; "
+                        "results stay bit-for-bit exact, at a host "
+                        "sync per chunk (PERF.md round 10)")
+    c.add_argument("-no-spill", dest="spill", action="store_const",
+                   const="off",
+                   help="remove the spill rung from the degradation "
+                        "ladder: a denied fpset regrow then falls "
+                        "through to chunk shrink / checkpoint + exit 75")
     c.add_argument("-max-regrow", dest="maxregrow", type=int, default=8,
                    metavar="N",
                    help="max auto-regrow events per run (each doubles one "

@@ -71,7 +71,10 @@ VIOLATION_NAMES = {
     VIOL_ASSERT: "Assertion failure (PlusCal assert)",
     VIOL_DEADLOCK: "Deadlock reached",
     VIOL_SLOT_OVERFLOW: "Codec slot overflow (raise ModelConfig bounds)",
-    VIOL_FPSET_FULL: "Fingerprint table full (raise fp_capacity)",
+    VIOL_FPSET_FULL: ("Fingerprint table full (auto-grow doubles it; "
+                      "when device memory is exhausted the host spill "
+                      "tier takes over - raise fp_capacity only to "
+                      "avoid the regrow recompiles)"),
     VIOL_QUEUE_FULL: "Frontier queue full (raise queue_capacity)",
     VIOL_ROUTE_OVERFLOW: "Routing bucket overflow (raise route_factor)",
 }
@@ -123,6 +126,12 @@ class EngineCarry(NamedTuple):
     obs_head: jnp.ndarray = None  # int32 level rows ever written
     obs_bodies: jnp.ndarray = None  # uint32 loop bodies executed
     obs_expanded: jnp.ndarray = None  # uint32 states popped so far
+    # --- host spill tier (None when spill mode is off) ----------------
+    # Cumulative count of candidates the HOST fingerprint store vetoed
+    # (already-seen fingerprints whose device-table entry was flushed to
+    # host RAM - engine.spill).  Present only on spill-mode carries, so
+    # every other engine keeps its exact checkpoint layout.
+    spill_hits: jnp.ndarray = None  # uint32
 
 
 class CheckResult(NamedTuple):
@@ -192,6 +201,288 @@ def make_engine(
     )
 
 
+def make_stage_pair(
+    backend,
+    ck: int,
+    *,
+    queue_capacity: int,
+    fp_capacity: int,
+    fp_highwater: float = DEFAULT_FP_HIGHWATER,
+    check_deadlock: bool = None,
+    fp_index: int = DEFAULT_FP_INDEX,
+    seed: int = DEFAULT_SEED,
+    obs_slots: int = 0,
+    spill: bool = False,
+):
+    """(pop_expand, commit) at pop width `ck` - the two halves of one
+    BFS step, shared by every composition: the unpipelined body runs
+    them back to back, the pipelined body runs commit on the PREVIOUS
+    block's staged ExpandOut while pop_expand works on the next block,
+    and the host spill driver (engine.spill) interleaves a host-tier
+    membership check between them.
+
+    spill=True builds the commit for spill mode: it takes an extra
+    `veto` mask ([ck * n_lanes] bool, candidates the HOST fingerprint
+    store already holds - treated exactly like a device-table hit: not
+    new, not enqueued, no stat credit), skips the fp-capacity halt (the
+    host driver flushes the device table before dispatching a chunk
+    that could overflow it, so the halt can never be needed), and
+    accumulates the cumulative `spill_hits` carry counter (obs ring
+    COL_SPILL).  Dedup verdicts are unchanged otherwise, so a spill-
+    mode run's final statistics are bit-for-bit a correctly-sized clean
+    run's (tests/test_spill.py pins this through the chaos matrix)."""
+    from ..obs.counters import (
+        pack_row,
+        ring_update,
+        sticky_overflow,
+        wrapped_any,
+    )
+    from .backend import make_expand_stage
+
+    cdc = backend.cdc
+    W = (cdc.nbits + 31) // 32
+    L = backend.n_lanes
+    n_labels = len(backend.labels)
+    qcap = queue_capacity
+    label_ids = jnp.arange(n_labels, dtype=jnp.int32)
+    ncand = ck * L
+    # compaction widths: probe/claim/enqueue touch only this many rows
+    # per segment; steady-state new-per-chunk == chunk, so 2x covers
+    # bursts and the segment loops keep worst cases exact
+    R = min(2 * ck, ncand)  # fpset probe width
+    CW = min(2 * ck, R)  # fpset round-0 claim width
+    A = min(2 * ck, ncand)  # enqueue/stat segment width
+    expand_fn = make_expand_stage(
+        backend, ck, check_deadlock, fp_index, seed
+    )
+
+    def pop_expand(c: EngineCarry):
+        """Expand stage: contiguous pop + backend expand.  Reads only
+        the pre-commit carry (queue buffer `parity`, which the commit
+        stage never writes), so XLA may schedule it alongside the
+        commit of the previous block."""
+        avail = c.level_n - c.qhead
+        n = jnp.clip(avail, 0, ck)
+        rows = jnp.arange(ck, dtype=jnp.int32)
+        mask = rows < n
+        # contiguous pop (the buffer is chunk-padded: no OOB clamping)
+        block = lax.dynamic_slice(
+            c.queue, (c.parity, c.qhead, jnp.int32(0)), (1, ck, W)
+        )[0]
+        batch = cdc.unpack(block)
+        return expand_fn(batch, mask), n
+
+    def commit(c: EngineCarry, ex, n, qhead_pop, qhead_out, veto=None):
+        """Commit stage for one block's ExpandOut `ex` (`n` popped
+        rows): fpset probe/claim over the sort-compacted candidates,
+        contiguous enqueue, counters, violation merge and level
+        fencing.  `qhead_pop` is the pop cursor right after `ex`'s
+        block was popped (the level-done basis); `qhead_out` is the
+        cursor to keep when the level does not flip (the pipelined
+        fused body passes the post-expand cursor here)."""
+        if spill:
+            # the host driver enforces device-tier residency, so the
+            # capacity halt is off; host-vetoed candidates dedup
+            # exactly like a device-table hit
+            fp_full = jnp.bool_(False)
+            insert_mask = ex.valid & ~veto
+        else:
+            fp_full = (c.distinct.astype(jnp.int32) + ncand) > int(
+                fp_capacity * fp_highwater
+            )
+            insert_mask = ex.valid & ~fp_full
+        fps, is_new_c, c_idx, nreps = fpset_insert_sorted(
+            c.fps, ex.lo, ex.hi, insert_mask,
+            probe_width=R, claim_width=CW,
+        )
+        n_new = is_new_c.sum().astype(jnp.int32)
+        q_full = c.next_n + n_new > qcap
+
+        # enqueue + per-new-state stats: bring new entries to the
+        # front ordered by original lane index (2-key sort) - the
+        # same append order as the v3 scatter engine, so pop order
+        # and therefore in-batch attribution statistics (outdegree
+        # min/max, MC.out:1104) are preserved bit-for-bit.  All new
+        # entries sit in the first nreps compacted positions, so
+        # when nreps fits the probe width the sort runs at R width
+        # instead of ncand (~6x less comparator traffic); the
+        # full-width branch covers all-distinct bursts.
+        new_key = (~is_new_c).astype(jnp.uint32)
+        cidx_u = c_idx.astype(jnp.uint32)
+
+        def e_sorted_sliced(_):
+            _, e = lax.sort(
+                (new_key[:R], cidx_u[:R]), num_keys=2, is_stable=True
+            )
+            return jnp.concatenate(
+                [e, jnp.zeros(ncand - R, jnp.uint32)]
+            )
+
+        def e_sorted_full(_):
+            _, e = lax.sort(
+                (new_key, cidx_u), num_keys=2, is_stable=True
+            )
+            return e
+
+        if R == ncand:
+            _, e_idx = lax.sort(
+                (new_key, cidx_u), num_keys=2, is_stable=True
+            )
+        else:
+            e_idx = lax.cond(
+                nreps <= R, e_sorted_sliced, e_sorted_full, 0
+            )
+        e_idx_p = jnp.concatenate([e_idx, jnp.zeros(A, jnp.uint32)])
+
+        def enq_cond(st):
+            _, _, s = st
+            return s * A < n_new
+
+        def enq_body(st):
+            queue, act_dist, s = st
+            offs = s * A
+            idx_a = lax.dynamic_slice(e_idx_p, (offs,), (A,)).astype(
+                jnp.int32
+            )
+            active = (jnp.arange(A) + offs) < n_new
+            rows_a = ex.packed[idx_a]  # [A, W] row gather (the only one)
+            woff = jnp.minimum(c.next_n + offs, qcap)
+            queue = lax.dynamic_update_slice(
+                queue, rows_a[None], (1 - c.parity, woff, jnp.int32(0))
+            )
+            # per-action distinct counts by [A, n_labels] compare-
+            # reduce (scatter-adds cost ~140ns/element on-chip)
+            acts_a = ex.action[idx_a]
+            act_dist = act_dist.at[:n_labels].add(
+                (
+                    (acts_a[:, None] == label_ids[None, :])
+                    & active[:, None]
+                ).sum(axis=0).astype(jnp.uint32)
+            )
+            return queue, act_dist, s + 1
+
+        queue, act_dist, _ = lax.while_loop(
+            enq_cond, enq_body, (c.queue, c.act_dist, jnp.int32(0))
+        )
+
+        # outdegree histogram of the popped states (TLC's outdegree =
+        # distinct new successors per expansion, MC.out:1104) via run
+        # lengths: e_idx's active prefix is ascending in source row,
+        # so each row's new-child count is a run length - no
+        # [chunk+1]-bin scatter-add
+        pos = jnp.arange(ncand)
+        active_new = pos < n_new
+        src_e = jnp.where(active_new, e_idx.astype(jnp.int32) // L, -1)
+        startf = jnp.concatenate(
+            [jnp.ones(1, bool), src_e[1:] != src_e[:-1]]
+        ) & active_new
+        endf = jnp.concatenate(
+            [src_e[1:] != src_e[:-1], jnp.ones(1, bool)]
+        ) & active_new
+        run0 = lax.cummax(jnp.where(startf, pos, 0))
+        run_len = jnp.where(endf, pos - run0 + 1, 0)
+        nruns = startf.sum()
+        deg_hist = (
+            (run_len[:, None] == jnp.arange(1, L + 1)[None, :])
+            .sum(axis=0)
+            .astype(jnp.uint32)
+        )
+        outdeg_hist = c.outdeg_hist.at[1 : L + 1].add(deg_hist)
+        outdeg_hist = outdeg_hist.at[0].add(
+            (n - nruns).astype(jnp.uint32)
+        )
+
+        act_gen = c.act_gen.at[:n_labels].add(ex.gen)
+        generated = c.generated + ex.valid.sum().astype(jnp.uint32)
+        distinct = c.distinct + n_new.astype(jnp.uint32)
+
+        # violations, first wins: carried > expand-stage (invariant >
+        # assert > deadlock > slot, pre-reduced in ex) > capacity
+        viol = c.viol
+        viol_state = c.viol_state
+        viol_action = c.viol_action
+        hit = (ex.viol != OK) & (viol == OK)
+        viol = jnp.where(hit, ex.viol, viol)
+        viol_state = jnp.where(hit, ex.viol_state, viol_state)
+        viol_action = jnp.where(hit, ex.viol_action, viol_action)
+        if not spill:
+            hit = fp_full & ex.valid.any() & (viol == OK)
+            viol = jnp.where(hit, VIOL_FPSET_FULL, viol)
+        hit = q_full & (viol == OK)
+        viol = jnp.where(hit, VIOL_QUEUE_FULL, viol)
+
+        # level bookkeeping: ping-pong at the level boundary
+        next_n = jnp.minimum(c.next_n + n_new, qcap)
+        level_done = qhead_pop >= c.level_n
+        advance = level_done & (next_n > 0)
+        parity = jnp.where(level_done, 1 - c.parity, c.parity)
+        level_n = jnp.where(level_done, next_n, c.level_n)
+        next_n = jnp.where(level_done, 0, next_n)
+        qhead = jnp.where(level_done, 0, qhead_out)
+        level = jnp.where(advance, c.level + 1, c.level)
+        depth = jnp.maximum(c.depth, level)
+
+        extra = {}
+        if spill:
+            extra["spill_hits"] = c.spill_hits + (
+                veto & ex.valid
+            ).sum().astype(jnp.uint32)
+        obs = {}
+        if obs_slots:
+            # one telemetry row per completed level (post-commit
+            # cumulative counters; the dump row absorbs non-flip
+            # bodies so the store is unconditional).  The sticky
+            # COL_OVERFLOW flag marks any uint32 wrap so saturated
+            # counters are detected, never silently wrong
+            obs_bodies = c.obs_bodies + jnp.uint32(1)
+            obs_expanded = c.obs_expanded + n.astype(jnp.uint32)
+            wrap_pairs = [
+                (generated, c.generated), (distinct, c.distinct),
+                (act_gen, c.act_gen), (act_dist, c.act_dist),
+                (obs_bodies, c.obs_bodies),
+                (obs_expanded, c.obs_expanded),
+            ]
+            if spill:
+                wrap_pairs.append((extra["spill_hits"], c.spill_hits))
+            wrapped = wrapped_any(wrap_pairs)
+            row = pack_row(
+                c.level, generated, distinct, level_n, obs_bodies,
+                obs_expanded, act_gen[:n_labels],
+                act_dist[:n_labels],
+                overflow=sticky_overflow(c.obs_ring, wrapped),
+                spill=extra.get("spill_hits"),
+            )
+            ring, head = ring_update(
+                c.obs_ring, c.obs_head, row, level_done
+            )
+            obs = dict(obs_ring=ring, obs_head=head,
+                       obs_bodies=obs_bodies,
+                       obs_expanded=obs_expanded)
+
+        return c._replace(
+            fps=fps,
+            queue=queue,
+            parity=parity,
+            qhead=qhead,
+            level_n=level_n,
+            next_n=next_n,
+            level=level,
+            depth=depth,
+            generated=generated,
+            distinct=distinct,
+            act_gen=act_gen,
+            act_dist=act_dist,
+            outdeg_hist=outdeg_hist,
+            viol=viol,
+            viol_state=viol_state,
+            viol_action=viol_action,
+            **extra,
+            **obs,
+        )
+
+    return pop_expand, commit
+
+
 def make_backend_engine(
     backend,
     chunk: int = 1024,
@@ -254,14 +545,8 @@ def make_backend_engine(
     bit-for-bit those of an obs-off run (bench.py --obs-ab gates the
     wall-clock overhead at <= 2%).
     """
-    from ..obs.counters import (
-        pack_row,
-        ring_new,
-        ring_update,
-        sticky_overflow,
-        wrapped_any,
-    )
-    from .backend import ExpandOut, make_expand_stage
+    from ..obs.counters import ring_new
+    from .backend import ExpandOut
 
     assert 0.0 < fp_highwater <= 1.0, "fp_highwater must be in (0, 1]"
     cdc = backend.cdc
@@ -355,228 +640,15 @@ def make_backend_engine(
         )
 
     def make_stages(ck: int):
-        """(pop_expand, commit) at pop width `ck` - the two halves of one
-        BFS step.  The unpipelined body runs them back to back; the
-        pipelined body runs commit on the PREVIOUS block's staged
-        ExpandOut while pop_expand works on the next block."""
-        ncand = ck * L
-        # compaction widths: probe/claim/enqueue touch only this many rows
-        # per segment; steady-state new-per-chunk == chunk, so 2x covers
-        # bursts and the segment loops keep worst cases exact
-        R = min(2 * ck, ncand)  # fpset probe width
-        CW = min(2 * ck, R)  # fpset round-0 claim width
-        A = min(2 * ck, ncand)  # enqueue/stat segment width
-        expand_fn = make_expand_stage(
-            backend, ck, check_deadlock, fp_index, seed
+        """(pop_expand, commit) at pop width `ck` - the module-level
+        make_stage_pair specialized to this engine's geometry (the
+        lift that lets the host spill driver, engine.spill, reuse the
+        exact commit the fused/pipelined bodies run)."""
+        return make_stage_pair(
+            backend, ck, queue_capacity=qcap, fp_capacity=fp_capacity,
+            fp_highwater=fp_highwater, check_deadlock=check_deadlock,
+            fp_index=fp_index, seed=seed, obs_slots=obs_slots,
         )
-
-        def pop_expand(c: EngineCarry):
-            """Expand stage: contiguous pop + backend expand.  Reads only
-            the pre-commit carry (queue buffer `parity`, which the commit
-            stage never writes), so XLA may schedule it alongside the
-            commit of the previous block."""
-            avail = c.level_n - c.qhead
-            n = jnp.clip(avail, 0, ck)
-            rows = jnp.arange(ck, dtype=jnp.int32)
-            mask = rows < n
-            # contiguous pop (the buffer is chunk-padded: no OOB clamping)
-            block = lax.dynamic_slice(
-                c.queue, (c.parity, c.qhead, jnp.int32(0)), (1, ck, W)
-            )[0]
-            batch = cdc.unpack(block)
-            return expand_fn(batch, mask), n
-
-        def commit(c: EngineCarry, ex, n, qhead_pop, qhead_out):
-            """Commit stage for one block's ExpandOut `ex` (`n` popped
-            rows): fpset probe/claim over the sort-compacted candidates,
-            contiguous enqueue, counters, violation merge and level
-            fencing.  `qhead_pop` is the pop cursor right after `ex`'s
-            block was popped (the level-done basis); `qhead_out` is the
-            cursor to keep when the level does not flip (the pipelined
-            fused body passes the post-expand cursor here)."""
-            fp_full = (c.distinct.astype(jnp.int32) + ncand) > int(
-                fp_capacity * fp_highwater
-            )
-            insert_mask = ex.valid & ~fp_full
-            fps, is_new_c, c_idx, nreps = fpset_insert_sorted(
-                c.fps, ex.lo, ex.hi, insert_mask,
-                probe_width=R, claim_width=CW,
-            )
-            n_new = is_new_c.sum().astype(jnp.int32)
-            q_full = c.next_n + n_new > qcap
-
-            # enqueue + per-new-state stats: bring new entries to the
-            # front ordered by original lane index (2-key sort) - the
-            # same append order as the v3 scatter engine, so pop order
-            # and therefore in-batch attribution statistics (outdegree
-            # min/max, MC.out:1104) are preserved bit-for-bit.  All new
-            # entries sit in the first nreps compacted positions, so
-            # when nreps fits the probe width the sort runs at R width
-            # instead of ncand (~6x less comparator traffic); the
-            # full-width branch covers all-distinct bursts.
-            new_key = (~is_new_c).astype(jnp.uint32)
-            cidx_u = c_idx.astype(jnp.uint32)
-
-            def e_sorted_sliced(_):
-                _, e = lax.sort(
-                    (new_key[:R], cidx_u[:R]), num_keys=2, is_stable=True
-                )
-                return jnp.concatenate(
-                    [e, jnp.zeros(ncand - R, jnp.uint32)]
-                )
-
-            def e_sorted_full(_):
-                _, e = lax.sort(
-                    (new_key, cidx_u), num_keys=2, is_stable=True
-                )
-                return e
-
-            if R == ncand:
-                _, e_idx = lax.sort(
-                    (new_key, cidx_u), num_keys=2, is_stable=True
-                )
-            else:
-                e_idx = lax.cond(
-                    nreps <= R, e_sorted_sliced, e_sorted_full, 0
-                )
-            e_idx_p = jnp.concatenate([e_idx, jnp.zeros(A, jnp.uint32)])
-
-            def enq_cond(st):
-                _, _, s = st
-                return s * A < n_new
-
-            def enq_body(st):
-                queue, act_dist, s = st
-                offs = s * A
-                idx_a = lax.dynamic_slice(e_idx_p, (offs,), (A,)).astype(
-                    jnp.int32
-                )
-                active = (jnp.arange(A) + offs) < n_new
-                rows_a = ex.packed[idx_a]  # [A, W] row gather (the only one)
-                woff = jnp.minimum(c.next_n + offs, qcap)
-                queue = lax.dynamic_update_slice(
-                    queue, rows_a[None], (1 - c.parity, woff, jnp.int32(0))
-                )
-                # per-action distinct counts by [A, n_labels] compare-
-                # reduce (scatter-adds cost ~140ns/element on-chip)
-                acts_a = ex.action[idx_a]
-                act_dist = act_dist.at[:n_labels].add(
-                    (
-                        (acts_a[:, None] == label_ids[None, :])
-                        & active[:, None]
-                    ).sum(axis=0).astype(jnp.uint32)
-                )
-                return queue, act_dist, s + 1
-
-            queue, act_dist, _ = lax.while_loop(
-                enq_cond, enq_body, (c.queue, c.act_dist, jnp.int32(0))
-            )
-
-            # outdegree histogram of the popped states (TLC's outdegree =
-            # distinct new successors per expansion, MC.out:1104) via run
-            # lengths: e_idx's active prefix is ascending in source row,
-            # so each row's new-child count is a run length - no
-            # [chunk+1]-bin scatter-add
-            pos = jnp.arange(ncand)
-            active_new = pos < n_new
-            src_e = jnp.where(active_new, e_idx.astype(jnp.int32) // L, -1)
-            startf = jnp.concatenate(
-                [jnp.ones(1, bool), src_e[1:] != src_e[:-1]]
-            ) & active_new
-            endf = jnp.concatenate(
-                [src_e[1:] != src_e[:-1], jnp.ones(1, bool)]
-            ) & active_new
-            run0 = lax.cummax(jnp.where(startf, pos, 0))
-            run_len = jnp.where(endf, pos - run0 + 1, 0)
-            nruns = startf.sum()
-            deg_hist = (
-                (run_len[:, None] == jnp.arange(1, L + 1)[None, :])
-                .sum(axis=0)
-                .astype(jnp.uint32)
-            )
-            outdeg_hist = c.outdeg_hist.at[1 : L + 1].add(deg_hist)
-            outdeg_hist = outdeg_hist.at[0].add(
-                (n - nruns).astype(jnp.uint32)
-            )
-
-            act_gen = c.act_gen.at[:n_labels].add(ex.gen)
-            generated = c.generated + ex.valid.sum().astype(jnp.uint32)
-            distinct = c.distinct + n_new.astype(jnp.uint32)
-
-            # violations, first wins: carried > expand-stage (invariant >
-            # assert > deadlock > slot, pre-reduced in ex) > capacity
-            viol = c.viol
-            viol_state = c.viol_state
-            viol_action = c.viol_action
-            hit = (ex.viol != OK) & (viol == OK)
-            viol = jnp.where(hit, ex.viol, viol)
-            viol_state = jnp.where(hit, ex.viol_state, viol_state)
-            viol_action = jnp.where(hit, ex.viol_action, viol_action)
-            hit = fp_full & ex.valid.any() & (viol == OK)
-            viol = jnp.where(hit, VIOL_FPSET_FULL, viol)
-            hit = q_full & (viol == OK)
-            viol = jnp.where(hit, VIOL_QUEUE_FULL, viol)
-
-            # level bookkeeping: ping-pong at the level boundary
-            next_n = jnp.minimum(c.next_n + n_new, qcap)
-            level_done = qhead_pop >= c.level_n
-            advance = level_done & (next_n > 0)
-            parity = jnp.where(level_done, 1 - c.parity, c.parity)
-            level_n = jnp.where(level_done, next_n, c.level_n)
-            next_n = jnp.where(level_done, 0, next_n)
-            qhead = jnp.where(level_done, 0, qhead_out)
-            level = jnp.where(advance, c.level + 1, c.level)
-            depth = jnp.maximum(c.depth, level)
-
-            obs = {}
-            if obs_slots:
-                # one telemetry row per completed level (post-commit
-                # cumulative counters; the dump row absorbs non-flip
-                # bodies so the store is unconditional).  The sticky
-                # COL_OVERFLOW flag marks any uint32 wrap so saturated
-                # counters are detected, never silently wrong
-                obs_bodies = c.obs_bodies + jnp.uint32(1)
-                obs_expanded = c.obs_expanded + n.astype(jnp.uint32)
-                wrapped = wrapped_any([
-                    (generated, c.generated), (distinct, c.distinct),
-                    (act_gen, c.act_gen), (act_dist, c.act_dist),
-                    (obs_bodies, c.obs_bodies),
-                    (obs_expanded, c.obs_expanded),
-                ])
-                row = pack_row(
-                    c.level, generated, distinct, level_n, obs_bodies,
-                    obs_expanded, act_gen[:n_labels],
-                    act_dist[:n_labels],
-                    overflow=sticky_overflow(c.obs_ring, wrapped),
-                )
-                ring, head = ring_update(
-                    c.obs_ring, c.obs_head, row, level_done
-                )
-                obs = dict(obs_ring=ring, obs_head=head,
-                           obs_bodies=obs_bodies,
-                           obs_expanded=obs_expanded)
-
-            return c._replace(
-                fps=fps,
-                queue=queue,
-                parity=parity,
-                qhead=qhead,
-                level_n=level_n,
-                next_n=next_n,
-                level=level,
-                depth=depth,
-                generated=generated,
-                distinct=distinct,
-                act_gen=act_gen,
-                act_dist=act_dist,
-                outdeg_hist=outdeg_hist,
-                viol=viol,
-                viol_state=viol_state,
-                viol_action=viol_action,
-                **obs,
-            )
-
-        return pop_expand, commit
 
     def make_body(ck: int):
         """One fused BFS step popping up to `ck` states: expand + commit

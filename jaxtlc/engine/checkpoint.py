@@ -204,10 +204,13 @@ def save_generation(base: str, carry, meta: dict, keep: int = 2) -> str:
     meta = {**meta, "generation": gen}
     save_checkpoint(path, carry, meta)
     for old_gen, old_path in gens[: max(0, len(gens) - (keep - 1))]:
-        try:
-            os.remove(old_path)
-        except OSError:
-            pass  # pruning is best-effort; never fail a save over it
+        # a spilling run pairs each generation with a host-tier file
+        # (engine.spill.spill_sibling); prune it with its generation
+        for victim in (old_path, old_path + ".spill"):
+            try:
+                os.remove(victim)
+            except OSError:
+                pass  # pruning is best-effort; never fail a save over it
     return path
 
 

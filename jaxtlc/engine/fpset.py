@@ -217,7 +217,8 @@ def unmix_host(lo: np.ndarray, hi: np.ndarray):
     fingerprints from stored table words.  The regrow migration
     (jaxtlc.resil.regrow) unmixes a saturated table's entries and feeds
     them back through fpset_insert_sorted into the larger geometry, so
-    the new table's stored words are reproduced exactly."""
+    the new table's stored words are reproduced exactly; the spill
+    flush (engine.spill) does the same device-to-host direction."""
     lo = np.asarray(lo, np.uint32).copy()
     hi = np.asarray(hi, np.uint32).copy()
     with np.errstate(over="ignore"):
@@ -225,6 +226,23 @@ def unmix_host(lo: np.ndarray, hi: np.ndarray):
             lo, hi = (
                 hi ^ _fmix32_np((lo + np.uint32(c)).astype(np.uint32)),
                 lo,
+            )
+    return lo, hi
+
+
+def mix_host_np(lo: np.ndarray, hi: np.ndarray):
+    """Vectorized host replica of _mix over uint32 arrays (the batch
+    form of mix_host, inverse of unmix_host).  The host spill tier
+    (engine.spill.SpillStore) keys its store on MIXED words so its
+    equality semantics - including the (0,0)->(1,0) remap class merge -
+    are bit-identical to the device table's."""
+    lo = np.asarray(lo, np.uint32).copy()
+    hi = np.asarray(hi, np.uint32).copy()
+    with np.errstate(over="ignore"):
+        for c in (0x9E3779B9, 0x517CC1B7, 0x27220A95):
+            lo, hi = (
+                hi.copy(),
+                lo ^ _fmix32_np((hi + np.uint32(c)).astype(np.uint32)),
             )
     return lo, hi
 
@@ -265,6 +283,57 @@ def host_insert(table: np.ndarray, lo: int, hi: int) -> bool:
             table[slot, 1] = hi
             return True
     raise CapacityError(cap, cap)
+
+
+def fpset_member(s: FPSet, lo, hi, mask,
+                 max_rounds: int = 0) -> jnp.ndarray:
+    """Membership-only probe (no insert, no mutation): True where the
+    masked fingerprint is already stored.  Walks the exact bucket
+    sequence of the insert path - a non-full bucket with no match ends
+    the walk (the lookup invariant in the module docstring), so the loop
+    terminates whenever the table is below full occupancy (the engines'
+    fp_highwater guarantees that).
+
+    This is the device-side filter of the host spill tier
+    (engine.spill): candidates found here are definitely-old and never
+    pay the PCIe/host round trip; only the probable-new remainder is
+    checked against the host store.
+
+    max_rounds > 0 BOUNDS the walk: lanes still unresolved after that
+    many bucket rounds report False.  That is safe for the filter use -
+    the result must never claim an absent fingerprint present (it
+    cannot: True still requires an exact word match), but a stored
+    fingerprint reported False merely pays the host round trip and
+    dedups correctly there/at insert.  Near the highwater load, absent
+    keys otherwise walk long full-bucket runs (the open-addressing
+    tail), and the while_loop runs to the WORST lane of the batch - the
+    cap keeps the filter O(max_rounds) per chunk (PERF.md round 10)."""
+    table = s.table
+    nb = table.shape[0]
+    lo, hi = _mix(lo, hi)
+    lo, hi = _remap(lo, hi)
+    bid = _bucket_of(hi, nb)
+
+    def cond(st):
+        _, pend, _, k = st
+        more = (k < max_rounds) if max_rounds else True
+        return pend.any() & more
+
+    def body(st):
+        cur, pend, found, k = st
+        row = table[jnp.where(pend, cur, 0)]  # [N, 2B] row gather
+        rlo, rhi = row[:, 0::2], row[:, 1::2]
+        hit = pend & ((rlo == lo[:, None]) & (rhi == hi[:, None])).any(1)
+        full = ((rlo != 0) | (rhi != 0)).all(axis=1)
+        found = found | hit
+        pend = pend & ~hit & full
+        cur = jnp.where(pend, (cur + 1) % nb, cur)
+        return cur, pend, found, k + 1
+
+    _, _, found, _ = lax.while_loop(
+        cond, body, (bid, mask, jnp.zeros_like(mask), jnp.int32(0))
+    )
+    return found
 
 
 def _probe_block(table, lo, hi, active, claim_width: int):

@@ -20,6 +20,13 @@ This module is the TPU-native equivalent (SURVEY.md §2.3 E12, §2.4):
 Multi-host scaling is the same code over a multi-host mesh (jax spans DCN
 transparently); no RMI analog is needed.  The driver validates this path on
 a virtual 8-device CPU mesh (`__graft_entry__.dryrun_multichip`).
+
+Capacity ladder note: the sharded engine has no host spill tier yet
+(SPILL_CAPABLE below) - per-device tables would each need their own
+host store plus a routing-aware flush, which is the ROADMAP #2/#3
+composition.  The supervisor's degradation ladder therefore skips the
+spill rung for sharded runs: a denied per-device fpset regrow falls
+through to checkpoint + exit 75 with the resume command.
 """
 
 from __future__ import annotations
@@ -38,6 +45,11 @@ try:  # jax >= 0.5 exports shard_map at top level
 except ImportError:  # pragma: no cover - older jax keeps it experimental
     from jax.experimental.shard_map import shard_map as _shard_map_impl
 from jax.sharding import Mesh, PartitionSpec as P
+
+# the supervisor's degradation ladder consults this before offering the
+# host spill tier (module docstring: per-device stores + routing-aware
+# flush are the ROADMAP #2/#3 composition, not built yet)
+SPILL_CAPABLE = False
 
 
 def shard_map(f, mesh, in_specs, out_specs, **kw):

@@ -29,7 +29,10 @@ accuracy):
                       saturated counters are detected, never silently
                       wrong (the jaxtlc.analysis counter-width audit
                       flags the risky configs before the run)
-    col 7  reserved
+    col 7  spill      cumulative host-spill-tier hits: candidates the
+                      host fingerprint store vetoed (engine.spill);
+                      always 0 on engines without the spill tier, so
+                      pre-spill ring layouts are unchanged
     col 8..8+A-1      per-action generated (cumulative)
     col 8+A..8+2A-1   per-action distinct  (cumulative)
 
@@ -48,8 +51,9 @@ DEFAULT_OBS_SLOTS = 256
 
 N_FIXED_COLS = 8
 (COL_LEVEL, COL_GENERATED, COL_DISTINCT, COL_QUEUE, COL_BODIES,
- COL_EXPANDED, COL_OVERFLOW, COL_RES1) = range(N_FIXED_COLS)
+ COL_EXPANDED, COL_OVERFLOW, COL_SPILL) = range(N_FIXED_COLS)
 COL_RES0 = COL_OVERFLOW  # pre-overflow name of col 6
+COL_RES1 = COL_SPILL  # pre-spill name of col 7
 
 
 def ring_cols(n_labels: int) -> int:
@@ -83,17 +87,19 @@ def ring_update(ring, head, row, flip):
 
 
 def pack_row(level, generated, distinct, queue, bodies, expanded,
-             act_gen, act_dist, overflow=None):
+             act_gen, act_dist, overflow=None, spill=None):
     """Assemble one ring row from carry scalars (device-side).
     `overflow` is the sticky uint32 saturation flag (COL_OVERFLOW);
-    None writes 0 (engines that predate the flag)."""
+    `spill` the cumulative host-spill-hit counter (COL_SPILL); None
+    writes 0 (engines that predate the flag / carry no spill tier)."""
     import jax.numpy as jnp
 
     u = jnp.uint32
     fixed = jnp.stack([
         level.astype(u), generated.astype(u), distinct.astype(u),
         queue.astype(u), bodies.astype(u), expanded.astype(u),
-        u(0) if overflow is None else overflow.astype(u), u(0),
+        u(0) if overflow is None else overflow.astype(u),
+        u(0) if spill is None else spill.astype(u),
     ])
     return jnp.concatenate(
         [fixed, act_gen.astype(u), act_dist.astype(u)]
@@ -156,6 +162,9 @@ def rows_from_ring(
             # sticky device-side saturation flag: totals in this row
             # (and every later one) may have wrapped uint32
             row["counter_overflow"] = True
+        if r[COL_SPILL]:
+            # host spill tier active: cumulative host-store vetoes
+            row["spill_hits"] = int(r[COL_SPILL])
         if labels is not None:
             a = len(labels)
             gen = r[N_FIXED_COLS:N_FIXED_COLS + a]

@@ -63,6 +63,20 @@ EVENTS = {
     "interrupted": {"signum": _OPT_NUM, "path": _OPT_STR,
                     "generated": _NUM, "distinct": _NUM, "queue": _NUM,
                     "wall_s": _NUM},
+    # one per degradation-ladder transition (resil.supervisor): rung in
+    # ("regrow", "spill", "shrink", "oom", "halt")
+    "degrade": {"rung": _STR, "resource": _STR, "action": _STR,
+                "reason": _STR},
+    # host spill tier lifecycle (engine.spill): phase in
+    # ("activate", "flush"); resident = device-tier occupancy after,
+    # spilled = host-store count, hits/probes = cumulative host traffic
+    "spill": {"phase": _STR, "resident": _NUM, "spilled": _NUM,
+              "capacity": _NUM, "hits": _NUM, "probes": _NUM},
+    # ladder rung 4: capacity unrecoverable, final checkpoint written
+    # (or path None = progress kept only in this journal), resume me
+    "exhausted": {"resource": _STR, "path": _OPT_STR,
+                  "generated": _NUM, "distinct": _NUM, "queue": _NUM,
+                  "wall_s": _NUM},
     # -- verdicts ----------------------------------------------------------
     "violation": {"code": _NUM, "name": _STR},
     # the structured final event: EVERY run (clean, violated, interrupted,
@@ -87,7 +101,7 @@ EVENTS = {
 
 # the verdict vocabulary of the "final" event
 VERDICTS = ("ok", "violation", "liveness_violation", "interrupted",
-            "error")
+            "exhausted", "error")
 
 
 class JournalSchemaError(ValueError):

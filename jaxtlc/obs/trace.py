@@ -180,6 +180,23 @@ def chrome_trace_events(events: List[dict]) -> List[dict]:
                     {"error": ev["error"]})
         elif kind == "fault":
             instant(ev, f"fault {ev['kind']}@{ev['at']}")
+        elif kind == "spill":
+            # the host tier's lifecycle rides the regrow thread (both
+            # are host-side capacity work); also a counter track so
+            # Perfetto graphs the cold-tier growth
+            instant(ev, f"spill {ev['phase']}",
+                    {"spilled": ev["spilled"], "hits": ev.get("hits"),
+                     "probes": ev.get("probes")})
+            out.append({"name": "spilled_fps", "ph": "C",
+                        "ts": us(ev["t"]), "pid": PID_HOST, "tid": 0,
+                        "args": {"spilled": ev["spilled"]}})
+        elif kind == "degrade":
+            instant(ev, f"degrade [{ev['rung']}] {ev['resource']}",
+                    {"action": ev["action"], "reason": ev["reason"]})
+        elif kind == "exhausted":
+            instant(ev, f"exhausted ({ev['resource']})",
+                    {"checkpoint": ev["path"],
+                     "distinct": ev["distinct"]})
         elif kind == "interrupted":
             instant(ev, f"interrupted (signal {ev['signum']})",
                     {"checkpoint": ev["path"]})
@@ -234,6 +251,12 @@ def _tiny_journal(path: str) -> None:
                 label="periodic")
         j.event("regrow", resource="fp_capacity", old=1 << 11,
                 new=1 << 12, violation="fpset full", seconds=0.01)
+        j.event("degrade", rung="regrow", resource="fp_capacity",
+                action="denied", reason="RESOURCE_EXHAUSTED (tiny)")
+        j.event("spill", phase="activate", resident=240, spilled=0,
+                capacity=1 << 12, hits=0, probes=0)
+        j.event("spill", phase="flush", resident=0, spilled=240,
+                capacity=1 << 12, hits=12, probes=60)
         j.event("retry", attempt=1, delay_s=0.01, error="injected")
         j.event("interrupted", signum=15, path=None, generated=400,
                 distinct=240, queue=30, wall_s=0.2)

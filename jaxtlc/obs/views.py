@@ -123,6 +123,35 @@ def render_tlc_event(log, ev: dict, resume_cmd: str = "") -> None:
         )
     elif kind == "interrupted":
         log.interrupted(ev["signum"], ev["path"], resume_cmd)
+    elif kind == "degrade":
+        log.msg(
+            1000,
+            f"Capacity ladder [{ev['rung']}] {ev['resource']}: "
+            f"{ev['action']} ({ev['reason']}).",
+            severity=1,
+        )
+    elif kind == "spill":
+        if ev["phase"] == "activate":
+            log.msg(
+                1000,
+                "Host fingerprint spill tier activated: device table "
+                f"stays at {ev['resident']:,} resident fingerprints, "
+                "cold fingerprints migrate to host RAM "
+                f"(store capacity {ev['capacity']:,}, auto-grows).",
+                severity=1,
+            )
+        # flushes are journal-only (one per highwater crossing - a
+        # banner each would flood the transcript; tlcstat shows them)
+    elif kind == "exhausted":
+        log.msg(
+            1000,
+            f"Capacity exhausted ({ev['resource']}): "
+            f"{ev['distinct']:,} distinct states checkpointed"
+            + (f" at {ev['path']}" if ev.get("path") else
+               " (no -checkpoint: progress lost)")
+            + (f"; resume with: {resume_cmd}" if resume_cmd else ""),
+            severity=1,
+        )
 
 
 _BENCH_BASE = {

@@ -2,10 +2,16 @@
 retry with backoff), carry migration across engine geometries, and the
 deterministic fault-injection harness that proves every recovery path."""
 
-from .faults import FaultInjector, FaultPlan, TransientFault  # noqa: F401
+from .faults import (  # noqa: F401
+    AllocDeniedFault,
+    FaultInjector,
+    FaultPlan,
+    TransientFault,
+)
 from .regrow import GROWABLE, grown  # noqa: F401
 from .supervisor import (  # noqa: F401
     EXIT_INTERRUPTED,
+    MIN_CHUNK,
     ShardedAdapter,
     SingleDeviceAdapter,
     SlotOverflowError,
@@ -13,5 +19,6 @@ from .supervisor import (  # noqa: F401
     SupervisorOptions,
     check_sharded_supervised,
     check_supervised,
+    is_resource_exhausted,
     supervise,
 )

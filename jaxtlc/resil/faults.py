@@ -19,6 +19,13 @@ The plan DSL (tools/chaos.py `--plan`):
                     the supervisor's retry/backoff path must absorb it)
     sigterm@K       deliver a real SIGTERM to this process when segment K
                     starts (the preemption drain path)
+    alloc_fail@N    deny the Nth regrow allocation probe (1-based) with
+                    an injected RESOURCE_EXHAUSTED - the degradation
+                    ladder must route fpset growth to the host spill
+                    tier instead of crashing mid-migration
+    spill_fail@N    raise OSError on the Nth host spill write (the
+                    device-table flush into the SpillStore, 1-based);
+                    the ladder must degrade to checkpoint + exit 75
 
 Entries are comma-separated: "transient@1,sigterm@3".  Each entry fires
 at most once.
@@ -37,6 +44,17 @@ class TransientFault(RuntimeError):
     failure the supervisor's retry-with-backoff absorbs)."""
 
 
+class AllocDeniedFault(MemoryError):
+    """An injected stand-in for a deterministic RESOURCE_EXHAUSTED
+    device-allocation failure (the class retry can NEVER fix - the
+    supervisor's degradation ladder must absorb it instead).  The
+    message carries the XLA status string so the supervisor's
+    classify-by-message path is exercised, not bypassed."""
+
+    def __init__(self, detail: str):
+        super().__init__(f"RESOURCE_EXHAUSTED: {detail} (injected)")
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
     """A deterministic fault schedule.  All members are sets of 1-based
@@ -46,12 +64,15 @@ class FaultPlan:
     truncate: FrozenSet[int] = frozenset()
     transient: FrozenSet[int] = frozenset()
     sigterm: FrozenSet[int] = frozenset()
+    alloc_fail: FrozenSet[int] = frozenset()
+    spill_fail: FrozenSet[int] = frozenset()
 
     @staticmethod
     def parse(spec: str) -> "FaultPlan":
         """Parse the chaos DSL ("write_fail@2,transient@1,sigterm@3")."""
         kinds = {"write_fail": set(), "truncate": set(),
-                 "transient": set(), "sigterm": set()}
+                 "transient": set(), "sigterm": set(),
+                 "alloc_fail": set(), "spill_fail": set()}
         for entry in filter(None, (e.strip() for e in spec.split(","))):
             try:
                 kind, at = entry.split("@")
@@ -73,6 +94,8 @@ class FaultInjector:
                  kill: Callable[[], None] = None):
         self.plan = plan or FaultPlan()
         self.writes = 0
+        self.alloc_probes = 0
+        self.spill_writes = 0
         self.fired = set()
         # test seam: default delivers a real SIGTERM to this process
         self._kill = kill or (
@@ -99,6 +122,30 @@ class FaultInjector:
             ("write_fail", self.writes)
         ):
             raise OSError(f"injected disk-write failure #{self.writes}")
+
+    def alloc_probe(self) -> None:
+        """Hook: the supervisor is about to probe-allocate a regrown
+        resource (counts 1-based).  An injected denial looks exactly
+        like XLA's RESOURCE_EXHAUSTED, so the ladder's classification
+        path is the one under test."""
+        self.alloc_probes += 1
+        if self.alloc_probes in self.plan.alloc_fail and self._once(
+            ("alloc_fail", self.alloc_probes)
+        ):
+            raise AllocDeniedFault(
+                f"regrow allocation probe #{self.alloc_probes} denied"
+            )
+
+    def spill_write(self) -> None:
+        """Hook: a device-table flush into the host spill store is
+        about to happen (counts 1-based)."""
+        self.spill_writes += 1
+        if self.spill_writes in self.plan.spill_fail and self._once(
+            ("spill_fail", self.spill_writes)
+        ):
+            raise OSError(
+                f"injected spill-write failure #{self.spill_writes}"
+            )
 
     def after_write(self, path: str) -> None:
         """Hook: checkpoint write #self.writes published `path`."""

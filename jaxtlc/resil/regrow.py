@@ -102,7 +102,7 @@ def migrate_table(old_table: np.ndarray, new_capacity: int,
 
 
 def migrate_engine_carry(
-    carry, old_params: Dict, new_params: Dict
+    carry, old_params: Dict, new_params: Dict, new_chunk: int = None
 ) -> EngineCarry:
     """Rebuild a single-device EngineCarry inside the new geometry.
 
@@ -110,9 +110,21 @@ def migrate_engine_carry(
     Counters, level fencing, and the pop cursor are preserved verbatim;
     only the containers are re-seated: the fingerprint table is
     re-bucketized into the larger capacity and the ping-pong level buffers
-    are copied into the wider queue (normalized to parity 0)."""
+    are copied into the wider queue (normalized to parity 0).
+
+    `new_chunk` re-seats the queue's chunk padding for a different pop
+    width (the degradation ladder's chunk-shrink rung): level contents
+    and every counter are unchanged, but the pop BATCHING changes, so
+    in-batch duplicate attribution (outdegree min/max, per-action
+    distinct splits of same-fingerprint candidates) may differ from a
+    clean run at the original chunk - total counts and the verdict do
+    not.  Unpipelined carries only (the staged block is chunk-shaped)."""
     chunk = (int(np.asarray(carry.queue).shape[1])
              - int(old_params["queue_capacity"])) // 2
+    if new_chunk is not None:
+        assert carry.st_n is None, \
+            "chunk re-seat supports unpipelined carries only"
+        chunk = int(new_chunk)
     W = int(np.asarray(carry.queue).shape[2])
     qcap2 = int(new_params["queue_capacity"])
     old_queue = np.asarray(carry.queue)
@@ -152,6 +164,12 @@ def migrate_engine_carry(
             for f in ("obs_ring", "obs_head", "obs_bodies",
                       "obs_expanded")
         })
+    # spill-mode hit counter: scalar telemetry, travels verbatim (the
+    # host store itself rolls back through SpillStore.snapshot/restore)
+    if getattr(carry, "spill_hits", None) is not None:
+        staged["spill_hits"] = jnp.asarray(
+            np.asarray(carry.spill_hits), jnp.uint32
+        )
 
     return EngineCarry(
         fps=fps2,

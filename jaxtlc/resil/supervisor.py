@@ -8,27 +8,52 @@ how TPU pods die), and transient XLA/device errors.  This module wraps
 the segmented drivers (engine.checkpoint / engine.sharded) in a
 supervision loop that converts all three from run-killers into events:
 
-* **Auto-regrow**: a capacity halt (VIOL_FPSET_FULL / VIOL_QUEUE_FULL /
-  VIOL_ROUTE_OVERFLOW) rebuilds the engine with the saturated resource
-  doubled, migrates the last-good carry into the new geometry
-  (resil.regrow) and replays the segment - final statistics provably
-  equal an uninterrupted correctly-sized run's.  Bounded by max_regrow.
-  VIOL_SLOT_OVERFLOW (codec bit-widths too narrow) is NOT regrowable -
-  it needs a recompile - and degrades to checkpoint + actionable error.
+* **The capacity degradation ladder**: a capacity halt (VIOL_FPSET_FULL
+  / VIOL_QUEUE_FULL / VIOL_ROUTE_OVERFLOW) walks rungs until one holds,
+  instead of the old binary regrow-or-die:
+
+  1. **regrow** - double the saturated resource, but only after a PROBE
+     ALLOCATION confirms the doubled buffer is allocatable (a
+     deterministic RESOURCE_EXHAUSTED used to crash mid-migration);
+     migrate the last-good carry (resil.regrow) and replay the segment -
+     final statistics provably equal an uninterrupted correctly-sized
+     run's.  Bounded by max_regrow.
+  2. **host spill tier** (fpset saturation on unpipelined single-device
+     runs) - activate engine.spill: cold fingerprints migrate to a
+     host-RAM SpillStore, the device table becomes the hot tier with an
+     fpset_member filter in front of the host round trip, and the run
+     COMPLETES inside the device memory it has - bit-for-bit the clean
+     run's counters/verdict.
+  3. **chunk shrink** - halve the pop width (freeing candidate-buffer
+     memory) and retry the regrow probe; repeats to a floor of 64.
+     Counts/verdict are preserved; in-batch duplicate attribution may
+     shift (documented in resil.regrow).
+  4. **checkpoint + exit 75** - write a final generation (host tier
+     included), journal an `exhausted` event with the resume command,
+     and return exhausted=True (the CLI exits EXIT_INTERRUPTED).
+
+  VIOL_SLOT_OVERFLOW (codec bit-widths too narrow) is NOT on the ladder
+  - it needs a recompile - and degrades to checkpoint + actionable
+  error as before.
 * **Preemption safety**: SIGTERM/SIGINT finish the current segment,
   write a final checkpoint generation, and return `interrupted=True`
   (the CLI exits with EXIT_INTERRUPTED and prints the resume command).
-* **Retry with backoff**: transient errors around segment execution are
+* **Retry with backoff**: TRANSIENT errors around segment execution are
   retried from the last good carry with exponential backoff + jitter
-  (deterministic, seeded) up to `retries` attempts.
+  (deterministic, seeded) up to `retries` attempts.  Runtime errors are
+  CLASSIFIED first: a RESOURCE_EXHAUSTED/OOM is deterministic - it goes
+  to the ladder immediately instead of burning the whole retry budget.
 * **Crash-consistent storage**: checkpoints are CRC-manifested,
   fsync'd, generation-numbered files; resume loads the newest generation
   that passes verification, falling back past a torn newest file, and
   rebuilds the engine with the geometry THE CHECKPOINT RECORDS - so a
-  resume command never needs to repeat auto-grown capacities.
+  resume command never needs to repeat auto-grown capacities.  A
+  spilling run pairs every generation with a CRC'd host-tier file
+  (PATH.gNNNNNN.npz.spill); `-recover` restores BOTH tiers bit-for-bit
+  or falls back to the previous intact pair.
 
 Every recovery path is proven by fault injection (resil.faults,
-tools/chaos.py, tests/test_resil.py).
+tools/chaos.py --matrix, tests/test_resil.py, tests/test_spill.py).
 """
 
 from __future__ import annotations
@@ -56,6 +81,7 @@ from ..engine.bfs import (
     result_from_carry,
 )
 from ..engine.fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
+from ..engine.spill import SpillWriteError
 from .faults import FaultInjector, FaultPlan, TransientFault
 from .regrow import (
     GROWABLE,
@@ -64,8 +90,11 @@ from .regrow import (
     migrate_shard_carry,
 )
 
-# exception types treated as transient (retried with backoff); the
-# injected stand-in plus whatever XLA runtime error type this jax exposes
+# exception types the segment-retry loop CATCHES; the injected stand-in
+# plus whatever XLA runtime error type this jax exposes.  Caught is not
+# retried: every caught error is classified first (is_resource_exhausted)
+# - a deterministic RESOURCE_EXHAUSTED routes to the degradation ladder,
+# only genuinely transient errors get the backoff budget.
 _TRANSIENT: tuple = (TransientFault,)
 try:  # pragma: no cover - depends on the installed jaxlib
     from jax.errors import JaxRuntimeError
@@ -79,9 +108,40 @@ except ImportError:  # pragma: no cover
     except ImportError:
         pass
 
+# python-level allocation failures (and the injected AllocDeniedFault,
+# a MemoryError) are caught alongside the runtime errors - they are
+# always classified as resource exhaustion, never retried
+_CAUGHT: tuple = _TRANSIENT + (MemoryError,)
+
+# XLA status markers of a deterministic allocation failure.  Retrying
+# these with backoff burned the full retry budget before dying (the
+# PR 2 overreach); the ladder absorbs them instead.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Resource exhausted",
+                "Out of memory", "Allocation failure")
+
+
+def is_resource_exhausted(e: BaseException) -> bool:
+    """Classify a caught runtime error: True for deterministic
+    device/host allocation failures (route to the degradation ladder),
+    False for the transient class (retry with backoff).  XLA surfaces
+    its status code in the message, so classification is by
+    status-string; MemoryError (python hosts + the injected
+    AllocDeniedFault) is always exhaustion."""
+    if isinstance(e, MemoryError):
+        return True
+    msg = str(e)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
 # CLI exit code for an interrupted-but-checkpointed run (EX_TEMPFAIL:
-# "try again later" - distinct from 0/12/13 so schedulers can requeue)
+# "try again later" - distinct from 0/12/13 so schedulers can requeue).
+# Capacity exhaustion that survives to a checkpoint (ladder rung 4)
+# exits with the same code: both mean "resume me".
 EXIT_INTERRUPTED = 75
+
+# chunk-shrink floor of the ladder's rung 3 (below this the fixed
+# per-step overheads dominate and halving frees almost nothing)
+MIN_CHUNK = 64
 
 
 class SlotOverflowError(RuntimeError):
@@ -119,8 +179,18 @@ class SupervisorOptions:
     keep_generations: int = 2
     resume: bool = False
     faults: Optional[FaultPlan] = None
+    # host spill tier policy (CLI -spill/-no-spill): "auto" activates it
+    # when an fpset regrow is denied by the allocation probe (or
+    # max_regrow is exhausted); "on" prefers it over regrowing at the
+    # FIRST fpset saturation; "off" removes the rung from the ladder
+    spill: str = "auto"
+    # initial host-store capacity (auto-grows in host RAM)
+    spill_capacity: int = 1 << 15
+    # rung-3 floor: chunk never shrinks below this
+    min_chunk: int = MIN_CHUNK
     # on_event(kind, info_dict): checkpoint / ckpt_write_failed / recovery
-    # / regrow / retry / interrupted / progress - the tlc_log banner seam
+    # / regrow / retry / interrupted / progress / spill / degrade /
+    # exhausted - the tlc_log banner seam
     on_event: Optional[Callable[[str, dict], None]] = None
 
 
@@ -134,6 +204,12 @@ class SupervisedResult(NamedTuple):
     ckpt_writes: int
     ckpt_write_s: float  # total seconds spent writing checkpoints
     regrow_s: float  # total seconds spent in regrow migration + rebuild
+    # --- degradation-ladder telemetry (defaults keep old callers) -----
+    exhausted: bool = False  # rung 4: capacity unrecoverable, resume me
+    spilled: int = 0  # fingerprints resident in the host spill store
+    spill_flushes: int = 0  # device-table -> host-store migrations
+    spill_hits: int = 0  # candidates the host tier vetoed
+    shrinks: int = 0  # rung-3 chunk halvings
 
 
 class _SignalCatcher:
@@ -273,6 +349,51 @@ class SingleDeviceAdapter:
     def migrate(self, carry, old_params: dict, new_params: dict):
         return migrate_engine_carry(carry, old_params, new_params)
 
+    # ---- degradation-ladder seams (engine.spill / chunk shrink) -------
+
+    def supports_spill(self) -> bool:
+        # the spill driver runs the unpipelined fused stages; a
+        # pipelined carry's staged block has no spill composition (the
+        # ladder degrades those runs to the next rung instead)
+        return not self.pipeline
+
+    def build_spill(self, params: dict, store, on_event=None,
+                    spill_write_hook=None):
+        """A SpillRuntime over this adapter's backend + geometry (the
+        supervisor swaps its segment function for the runtime's when
+        the ladder activates the host tier)."""
+        from ..engine.spill import SpillRuntime
+
+        backend = self.backend
+        check_deadlock = self.check_deadlock
+        if backend is None:
+            from ..engine.backend import kubeapi_backend
+
+            backend = kubeapi_backend(self.cfg)
+            check_deadlock = None  # the kubeapi backend's own default
+        return SpillRuntime(
+            backend, self.chunk, params["queue_capacity"],
+            params["fp_capacity"], fp_index=self.fp_index,
+            seed=self.seed, fp_highwater=self.fp_highwater,
+            check_deadlock=check_deadlock, obs_slots=self.obs_slots,
+            store=store, on_event=on_event,
+            spill_write_hook=spill_write_hook,
+        )
+
+    def can_shrink(self, floor: int = MIN_CHUNK) -> bool:
+        return not self.pipeline and self.chunk // 2 >= floor
+
+    def reseat_chunk(self, carry, params: dict):
+        """Halve the pop width: re-seat the carry's queue padding for
+        chunk/2 and record the new width (rung 3 - counts/verdict
+        preserved, in-batch attribution caveat in resil.regrow)."""
+        new_chunk = self.chunk // 2
+        migrated = migrate_engine_carry(
+            carry, params, params, new_chunk=new_chunk
+        )
+        self.chunk = new_chunk
+        return migrated
+
     def result(self, carry, wall: float, segments: int,
                params: dict) -> CheckResult:
         from ..engine.fpset import fpset_actual_collision
@@ -364,6 +485,11 @@ class ShardedAdapter:
                                * int(self.mesh.devices.size)),
         )
 
+    def supports_spill(self) -> bool:
+        from ..engine.sharded import SPILL_CAPABLE
+
+        return SPILL_CAPABLE
+
     def migrate(self, carry, old_params: dict, new_params: dict):
         return migrate_shard_carry(carry, old_params, new_params)
 
@@ -409,11 +535,18 @@ def _emit(opts: SupervisorOptions, kind: str, **info) -> None:
         opts.on_event(kind, info)
 
 
-def _resume(adapter, params: dict, opts: SupervisorOptions):
+def _resume(adapter, params: dict, opts: SupervisorOptions,
+            make_spill_runtime):
     """Load the newest verifiable checkpoint of the family `ckpt_path`
     (generations first, then the plain file for pre-supervisor
-    snapshots), rebuilding the engine with the recorded geometry.
-    Returns (params, template, seg_fn, carry, path)."""
+    snapshots), rebuilding the engine with the recorded geometry.  A
+    checkpoint whose meta records an active spill tier restores the
+    paired host-store file too (engine.spill.spill_sibling) - a torn
+    or missing sibling fails the WHOLE generation, falling back to the
+    previous intact pair, so the two tiers can never resume skewed.
+    Returns (params, template, seg_fn, carry, path, spill_rt)."""
+    from ..engine.spill import SpillStore, spill_sibling
+
     base = opts.ckpt_path
     cands = [p for _, p in reversed(ckpt.list_generations(base))]
     if os.path.exists(base):
@@ -429,17 +562,70 @@ def _resume(adapter, params: dict, opts: SupervisorOptions):
             _emit(opts, "ckpt_fallback", path=path, error=str(e))
             continue
         new_params = _params_from_meta(adapter, meta, params)
-        template, seg_fn = adapter.build(new_params, opts.ckpt_every)
+        spill_rt = None
+        if (meta.get("spill") or {}).get("active"):
+            try:
+                store = SpillStore.load(spill_sibling(path))
+            except (ckpt.CheckpointCorruptError, OSError,
+                    FileNotFoundError, KeyError) as e:
+                last_err = e
+                _emit(opts, "ckpt_fallback", path=path,
+                      error=f"spill sibling: {e}")
+                continue
+            spill_rt = make_spill_runtime(new_params, store)
+            template = spill_rt.init_fn()
+            seg_fn = spill_rt.segment_fn(opts.ckpt_every)
+        else:
+            template, seg_fn = adapter.build(new_params, opts.ckpt_every)
         try:
             _, carry = ckpt.load_checkpoint(path, template)
         except ckpt.CheckpointCorruptError as e:
             last_err = e
             _emit(opts, "ckpt_fallback", path=path, error=str(e))
             continue
-        return new_params, template, seg_fn, carry, path
+        return new_params, template, seg_fn, carry, path, spill_rt
     raise FileNotFoundError(
         f"no intact checkpoint under {base!r} (newest failure: {last_err})"
     )
+
+
+def _probe_grow(resource: str, new_value, faults) -> Optional[str]:
+    """The regrow allocation probe: confirm the DOUBLED resource is
+    allocatable before tearing into a carry migration (a denied
+    allocation used to crash mid-regrow - the exact moment the run
+    mattered most).  Returns None when allocatable, else the denial
+    reason.  Sized per resource (bytes of the new container, the
+    dominant term; route_factor buckets are too small to probe)."""
+    import jax
+    import jax.numpy as jnp
+
+    nbytes = {
+        "fp_capacity": 8,  # 2 uint32 words per slot
+        "queue_capacity": 64,  # 2 buffers x packed words, upper bound
+        "route_factor": 0,
+    }.get(resource, 8) * int(new_value if resource != "route_factor"
+                             else 0)
+    try:
+        faults.alloc_probe()
+        if nbytes > 0:
+            buf = jnp.zeros(nbytes, jnp.uint8)
+            jax.block_until_ready(buf)
+            del buf
+        return None
+    except Exception as e:  # noqa: BLE001 - classified right below
+        if is_resource_exhausted(e):
+            return str(e)
+        raise
+
+
+def _supports_spill(adapter) -> bool:
+    f = getattr(adapter, "supports_spill", None)
+    return bool(f()) if callable(f) else False
+
+
+def _can_shrink(adapter, floor: int) -> bool:
+    f = getattr(adapter, "can_shrink", None)
+    return bool(f(floor)) if callable(f) else False
 
 
 def supervise(adapter, params: dict,
@@ -448,20 +634,52 @@ def supervise(adapter, params: dict,
     adapter's growable geometry (queue_capacity, fp_capacity, and
     route_factor for the sharded adapter); everything else is fixed in
     the adapter.  Returns the final CheckResult plus recovery telemetry.
-    """
+
+    Capacity exhaustion walks the degradation ladder (module
+    docstring): probed regrow -> host spill tier -> chunk shrink ->
+    checkpoint + exhausted=True.  When the spill tier is active the
+    supervisor keeps a host-store SNAPSHOT paired with every last-good
+    carry, so retry/regrow replays roll both tiers back in lock-step
+    (a store that ran ahead of a rolled-back carry would veto states
+    the carry has not counted yet - a silent undercount)."""
     opts = opts or SupervisorOptions()
     faults = FaultInjector(opts.faults)
     rng = random.Random(0xC0FFEE)  # deterministic backoff jitter
     params = dict(params)
-    regrows = retries_used = segments = ckpt_writes = 0
+    regrows = retries_used = segments = ckpt_writes = shrinks = 0
     ckpt_write_s = regrow_s = 0.0
-    interrupted = False
+    interrupted = exhausted = False
+    exhaust_resource = ""
+    spill_rt = None  # engine.spill.SpillRuntime once the tier is active
+    good_store = None  # SpillStoreSnapshot paired with `good`
+
+    def emit_info(kind, info):
+        _emit(opts, kind, **info)
+
+    def make_spill_runtime(p, store):
+        return adapter.build_spill(
+            p, store, on_event=emit_info,
+            spill_write_hook=faults.spill_write,
+        )
+
+    def rebuild(p):
+        """(template, seg_fn) for geometry `p` in the CURRENT mode: the
+        spill runtime is rebuilt around the same host store when the
+        tier is active (queue regrow / chunk shrink under spill)."""
+        nonlocal spill_rt
+        if spill_rt is not None:
+            old = spill_rt
+            spill_rt = make_spill_runtime(p, old.store)
+            spill_rt.flushes = old.flushes
+            spill_rt.probes = old.probes
+            return spill_rt.init_fn(), spill_rt.segment_fn(opts.ckpt_every)
+        return adapter.build(p, opts.ckpt_every)
 
     if opts.resume:
         if not opts.ckpt_path:
             raise ValueError("resume requires a checkpoint path")
-        params, template, seg_fn, carry, path = _resume(
-            adapter, params, opts
+        params, template, seg_fn, carry, path, spill_rt = _resume(
+            adapter, params, opts, make_spill_runtime
         )
         prog = adapter.progress(carry)
         _emit(opts, "recovery", path=path, depth=prog[0],
@@ -473,31 +691,47 @@ def supervise(adapter, params: dict,
     # (regrow rebuilds DO count: recompilation is part of regrow's price)
     t0 = time.time()
 
-    def save(carry_to_save, label: str):
+    def save(carry_to_save, label: str, store_snap=None):
         nonlocal ckpt_writes, ckpt_write_s
         if not opts.ckpt_path:
             return None
         faults.before_write()
         t = time.time()
+        meta = adapter.meta(params)
+        if spill_rt is not None and store_snap is not None:
+            # the host tier travels as a CRC'd sibling file; meta
+            # records it so -recover knows to restore BOTH tiers
+            meta["spill"] = {
+                "active": True, "count": int(store_snap.count),
+                "capacity": int(store_snap.table.shape[0]),
+            }
         path = ckpt.save_generation(
-            opts.ckpt_path, carry_to_save, adapter.meta(params),
+            opts.ckpt_path, carry_to_save, meta,
             keep=opts.keep_generations,
         )
+        if spill_rt is not None and store_snap is not None:
+            from ..engine.spill import save_snapshot, spill_sibling
+
+            save_snapshot(spill_sibling(path), store_snap)
         # refresh the plain family head too (hardlink, no data copy):
         # non-supervised tooling and the TLC `-recover` muscle memory
         # expect the checkpoint to exist under the path the user gave
-        tmp = opts.ckpt_path + ".head.tmp"
-        try:
-            os.link(path, tmp)
-            os.replace(tmp, opts.ckpt_path)
-        except OSError:
+        heads = [(path, opts.ckpt_path)]
+        if spill_rt is not None and store_snap is not None:
+            heads.append((path + ".spill", opts.ckpt_path + ".spill"))
+        for src_path, head in heads:
+            tmp = head + ".head.tmp"
             try:
-                import shutil
-
-                shutil.copyfile(path, tmp)
-                os.replace(tmp, opts.ckpt_path)
+                os.link(src_path, tmp)
+                os.replace(tmp, head)
             except OSError:
-                pass
+                try:
+                    import shutil
+
+                    shutil.copyfile(src_path, tmp)
+                    os.replace(tmp, head)
+                except OSError:
+                    pass
         ckpt_write_s += time.time() - t
         ckpt_writes += 1
         faults.after_write(path)
@@ -506,6 +740,8 @@ def supervise(adapter, params: dict,
         return path
 
     good = carry
+    if spill_rt is not None:
+        good_store = spill_rt.store.snapshot()
     # observability cursor: ring rows below this head are already
     # journaled.  A resumed carry starts past its restored history (the
     # original journal already holds those levels); regrow/retry replays
@@ -517,21 +753,30 @@ def supervise(adapter, params: dict,
     # deferred periodic checkpoint: written while the NEXT segment is in
     # flight, so snapshot serialization/fsync overlaps device execution
     # instead of stalling the step loop (the carry is safe to read
-    # concurrently because the engines are built donate=False here)
+    # concurrently because the engines are built donate=False here).
+    # In spill mode the pair (carry, host-store snapshot) is deferred
+    # TOGETHER so the two tiers can never publish skewed.
     pending_save = None
 
     def flush_save():
         nonlocal pending_save
         if pending_save is None:
             return
-        c = pending_save
+        c, snap = pending_save
         pending_save = None
         try:
-            save(c, "periodic")
+            save(c, "periodic", store_snap=snap)
         except OSError as e:
             # a failed snapshot write must not kill a healthy run; the
             # next segment boundary retries
             _emit(opts, "ckpt_write_failed", error=str(e))
+
+    def rollback_store():
+        """Roll the host tier back to the last-good boundary: a failed
+        or violated segment may have flushed device entries into the
+        store, and a store ahead of the carry silently undercounts."""
+        if spill_rt is not None and good_store is not None:
+            spill_rt.store.restore(good_store)
 
     with _SignalCatcher() as sig:
         while not adapter.done(carry):
@@ -539,8 +784,10 @@ def supervise(adapter, params: dict,
                 interrupted = True
                 break
 
-            # ---- one segment, with retry/backoff around transients ----
+            # ---- one segment: classify, then retry only transients ----
             attempt = 0
+            oom = None
+            spill_broken = None
             while True:
                 try:
                     faults.segment_start(segments)
@@ -552,7 +799,19 @@ def supervise(adapter, params: dict,
                     carry2 = jax.block_until_ready(in_flight)
                     t_fence = time.time()
                     break
-                except _TRANSIENT as e:
+                except SpillWriteError as e:
+                    # the host tier cannot absorb the full device table:
+                    # retrying cannot help (the table stays full) - the
+                    # ladder's final rung takes it
+                    spill_broken = e
+                    break
+                except _CAUGHT as e:
+                    if is_resource_exhausted(e):
+                        # deterministic RESOURCE_EXHAUSTED: retrying it
+                        # burned the whole backoff budget before dying
+                        # (the PR 2 overreach) - the ladder absorbs it
+                        oom = e
+                        break
                     if attempt >= opts.retries:
                         raise
                     delay = min(
@@ -564,12 +823,18 @@ def supervise(adapter, params: dict,
                     time.sleep(delay)
                     attempt += 1
                     retries_used += 1
-                    # restore from the last good on-disk snapshot when one
-                    # exists (device state may be gone after a real device
-                    # error); otherwise retry from the in-memory good carry
-                    if opts.ckpt_path and ckpt.list_generations(
+                    if spill_rt is not None:
+                        # both tiers roll back together; the on-disk
+                        # path below cannot guarantee a tier-consistent
+                        # pair mid-retry, so spill retries stay in-memory
+                        rollback_store()
+                    elif opts.ckpt_path and ckpt.list_generations(
                         opts.ckpt_path
                     ):
+                        # restore from the last good on-disk snapshot
+                        # when one exists (device state may be gone
+                        # after a real device error); otherwise retry
+                        # from the in-memory good carry
                         try:
                             _, _, good = ckpt.load_latest_generation(
                                 opts.ckpt_path, template
@@ -577,45 +842,145 @@ def supervise(adapter, params: dict,
                         except FileNotFoundError:
                             pass
 
+            if spill_broken is not None:
+                # ladder rung 4 via the spill-write-failure edge:
+                # checkpoint what we have (the last-good pair is still
+                # consistent - the failed flush never touched the
+                # store) and hand back a resumable exit
+                rollback_store()
+                _emit(opts, "degrade", rung="halt", resource="spill",
+                      action="checkpoint+exit", reason=str(spill_broken))
+                exhausted = interrupted = True
+                exhaust_resource = "spill"
+                carry = good
+                break
+
+            if oom is not None:
+                rollback_store()
+                can = _can_shrink(adapter, opts.min_chunk)
+                _emit(opts, "degrade", rung="oom", resource="segment",
+                      action="shrink" if can else "halt",
+                      reason=str(oom))
+                if can:
+                    old_chunk = adapter.chunk
+                    good = adapter.reseat_chunk(good, params)
+                    shrinks += 1
+                    template, seg_fn = rebuild(params)
+                    carry = good
+                    _emit(opts, "degrade", rung="shrink",
+                          resource="chunk",
+                          action=f"{old_chunk}->{adapter.chunk}",
+                          reason=str(oom))
+                    continue
+                exhausted = interrupted = True
+                exhaust_resource = "segment"
+                carry = good
+                break
+
             v = adapter.viol(carry2)
             if v in GROWABLE:
                 resource = GROWABLE[v]
-                if not opts.auto_grow or regrows >= opts.max_regrow:
-                    carry = carry2  # report the halt as-is
+                if not opts.auto_grow:
+                    carry = carry2  # explicit opt-out: report the halt
                     break
-                new_params = grown(params, resource)
-                t = time.time()
-                # route_factor is an engine-geometry-only knob for the
-                # carry's containers, but a PIPELINED sharded carry sizes
-                # its pending-verdict buffers by the route bucket width -
-                # migrate() drains + re-seats them (pass-through
-                # otherwise)
-                migrated = adapter.migrate(good, params, new_params)
-                template, seg_fn = adapter.build(
-                    new_params, opts.ckpt_every
+                rollback_store()
+                denial = None
+                spill_first = (
+                    resource == "fp_capacity" and opts.spill == "on"
+                    and spill_rt is None and _supports_spill(adapter)
                 )
-                regrow_s += time.time() - t
-                regrows += 1
-                _emit(opts, "regrow", resource=resource,
-                      old=params[resource], new=new_params[resource],
-                      violation=VIOLATION_NAMES.get(v, str(v)),
-                      regrows=regrows,
-                      seconds=round(time.time() - t, 3))
-                params = new_params
-                good = migrated
-                carry = migrated
-                continue  # replay the segment inside the new geometry
+                # ---- rung 1: probed regrow ---------------------------
+                if not spill_first:
+                    if regrows >= opts.max_regrow:
+                        denial = f"max-regrow ({opts.max_regrow}) reached"
+                    else:
+                        new_params = grown(params, resource)
+                        denial = _probe_grow(
+                            resource, new_params[resource], faults
+                        )
+                    if denial is None:
+                        t = time.time()
+                        # route_factor is an engine-geometry-only knob
+                        # for the carry's containers, but a PIPELINED
+                        # sharded carry sizes its pending-verdict
+                        # buffers by the route bucket width - migrate()
+                        # drains + re-seats them (pass-through otherwise)
+                        migrated = adapter.migrate(good, params,
+                                                   new_params)
+                        template, seg_fn = rebuild(new_params)
+                        regrow_s += time.time() - t
+                        regrows += 1
+                        _emit(opts, "regrow", resource=resource,
+                              old=params[resource],
+                              new=new_params[resource],
+                              violation=VIOLATION_NAMES.get(v, str(v)),
+                              regrows=regrows,
+                              seconds=round(time.time() - t, 3))
+                        params = new_params
+                        good = migrated
+                        carry = migrated
+                        continue  # replay inside the new geometry
+                    _emit(opts, "degrade", rung="regrow",
+                          resource=resource, action="denied",
+                          reason=denial)
+                # ---- rung 2: host spill tier (fpset only) ------------
+                if (resource == "fp_capacity" and opts.spill != "off"
+                        and spill_rt is None
+                        and _supports_spill(adapter)):
+                    from ..engine.spill import SpillStore
+
+                    spill_rt = make_spill_runtime(
+                        params, SpillStore(opts.spill_capacity)
+                    )
+                    template = spill_rt.init_fn()
+                    seg_fn = spill_rt.segment_fn(opts.ckpt_every)
+                    good = spill_rt.adopt(good)
+                    carry = good
+                    good_store = spill_rt.store.snapshot()
+                    reason = denial or "spill-first policy (-spill)"
+                    _emit(opts, "degrade", rung="spill",
+                          resource=resource, action="activate",
+                          reason=reason)
+                    prog = adapter.progress(good)
+                    _emit(opts, "spill", phase="activate",
+                          resident=prog[2], spilled=0,
+                          capacity=spill_rt.store.capacity,
+                          hits=0, probes=0)
+                    continue  # replay through the two-tier dedup
+                # ---- rung 3: chunk shrink, re-probe on recurrence ----
+                if _can_shrink(adapter, opts.min_chunk):
+                    old_chunk = adapter.chunk
+                    good = adapter.reseat_chunk(good, params)
+                    shrinks += 1
+                    template, seg_fn = rebuild(params)
+                    carry = good
+                    _emit(opts, "degrade", rung="shrink",
+                          resource="chunk",
+                          action=f"{old_chunk}->{adapter.chunk}",
+                          reason=denial or "capacity ladder")
+                    continue  # replay; the regrow probe retries next halt
+                # ---- rung 4: checkpoint + exit 75 --------------------
+                _emit(opts, "degrade", rung="halt", resource=resource,
+                      action="checkpoint+exit",
+                      reason=denial or "no ladder rung applicable")
+                exhausted = interrupted = True
+                exhaust_resource = resource
+                carry = good
+                break
 
             if v == VIOL_SLOT_OVERFLOW:
                 path = None
                 try:
-                    path = save(good, "slot-overflow")
+                    path = save(good, "slot-overflow",
+                                store_snap=good_store)
                 except OSError:
                     pass
                 raise SlotOverflowError(path)
 
             carry = carry2
             good = carry2
+            if spill_rt is not None:
+                good_store = spill_rt.store.snapshot()
             segments += 1
             # timeline telemetry: the host-observed dispatch -> fence
             # interval of the segment just completed (the trace
@@ -624,7 +989,7 @@ def supervise(adapter, params: dict,
                   t_dispatch=t_dispatch, t_fence=t_fence,
                   wall_s=round(t_fence - t_dispatch, 6))
             if opts.ckpt_path:
-                pending_save = good
+                pending_save = (good, good_store)
             if adapter.viol(carry) == OK and not adapter.done(carry):
                 d, g, di, q = adapter.progress(carry)
                 _emit(opts, "progress", depth=d, generated=g,
@@ -642,18 +1007,26 @@ def supervise(adapter, params: dict,
             pending_save = None  # superseded by the final generation
             path = None
             try:
-                path = save(good, "final")
+                path = save(good,
+                            "capacity-exhausted" if exhausted
+                            else "final",
+                            store_snap=good_store)
             except OSError as e:
                 _emit(opts, "ckpt_write_failed", error=str(e))
-            # the structured interruption record carries the counters
-            # and wall time even when NO checkpoint path is configured
-            # (path None = progress lost): the journal still ends with
-            # an accountable event, never a silent death
+            # the structured record carries the counters and wall time
+            # even when NO checkpoint path is configured (path None =
+            # progress lost): the journal still ends with an
+            # accountable event, never a silent death
             d, g, di, q = adapter.progress(good)
-            _emit(opts, "interrupted",
-                  signum=int(sig.hit) if sig.hit else None, path=path,
-                  generated=g, distinct=di, queue=q,
-                  wall_s=round(time.time() - t0, 6))
+            if exhausted:
+                _emit(opts, "exhausted", resource=exhaust_resource,
+                      path=path, generated=g, distinct=di, queue=q,
+                      wall_s=round(time.time() - t0, 6))
+            else:
+                _emit(opts, "interrupted",
+                      signum=int(sig.hit) if sig.hit else None,
+                      path=path, generated=g, distinct=di, queue=q,
+                      wall_s=round(time.time() - t0, 6))
         else:
             flush_save()
 
@@ -661,12 +1034,17 @@ def supervise(adapter, params: dict,
     result = adapter.result(carry, wall, segments, params)
     # every supervised run ends with exactly one structured final event:
     # verdict + counters + wall, whatever the exit path
-    verdict = ("interrupted" if interrupted
+    verdict = ("exhausted" if exhausted
+               else "interrupted" if interrupted
                else "violation" if result.violation != OK else "ok")
     _emit(opts, "final", verdict=verdict, generated=result.generated,
           distinct=result.distinct, depth=result.depth,
           queue=result.queue_left, wall_s=round(wall, 6),
           interrupted=interrupted)
+    spill_hits = 0
+    if spill_rt is not None and getattr(carry, "spill_hits",
+                                        None) is not None:
+        spill_hits = int(np.asarray(carry.spill_hits))
     return SupervisedResult(
         result=result,
         params=params,
@@ -677,6 +1055,11 @@ def supervise(adapter, params: dict,
         ckpt_writes=ckpt_writes,
         ckpt_write_s=round(ckpt_write_s, 6),
         regrow_s=round(regrow_s, 6),
+        exhausted=exhausted,
+        spilled=spill_rt.store.count if spill_rt is not None else 0,
+        spill_flushes=spill_rt.flushes if spill_rt is not None else 0,
+        spill_hits=spill_hits,
+        shrinks=shrinks,
     )
 
 

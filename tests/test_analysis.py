@@ -541,7 +541,8 @@ def test_selfcheck_registry_pinned():
     from jaxtlc.analysis.selfcheck import FACTORIES
 
     assert sorted(FACTORIES) == [
-        "enumerator", "fused", "pipelined", "sharded", "struct",
+        "enumerator", "fused", "pipelined", "sharded", "spill",
+        "struct",
     ]
 
 
@@ -557,7 +558,7 @@ def test_selfcheck_tiny_smoke():
         rc = main(["--self-check", "--tiny"])
     out = buf.getvalue()
     assert rc == 0, out
-    for name in ("fused", "pipelined", "sharded", "struct",
+    for name in ("fused", "pipelined", "sharded", "spill", "struct",
                  "enumerator"):
         assert f"audit {name}: ok" in out, out
 

@@ -1,0 +1,347 @@
+"""Capacity-lifeboat tests (ISSUE 7): the host spill fingerprint tier
+and the resource-exhaustion degradation ladder.
+
+- the SpillStore mirrors the device table's equality semantics
+  bit-for-bit (mixed words, remap class merge, host_insert slot walk),
+  snapshots/restores deterministically, and round-trips through the
+  CRC'd checkpoint machinery;
+- fpset_member is a sound, complete membership filter;
+- a deterministic RESOURCE_EXHAUSTED is routed to the ladder, never
+  the retry budget (the PR 2 transient-overreach fix);
+- the chaos ladder matrix (tools/chaos.py --matrix --tiny): an
+  undersized FF run whose regrow is denied by alloc_fail completes via
+  the spill tier with final statistics BIT-IDENTICAL to a
+  correctly-sized clean run, through SIGTERM + -recover of both tiers
+  and through a spill-write failure -> checkpoint + exhausted; spill
+  occupancy / ladder transitions land as schema-validated journal
+  events, in the counter ring's COL_SPILL column, and on the tlcstat
+  dashboard.  (The Model_1-scale variant is a slow test.)
+
+Engine-compile budget: the unit tests build no engines; the matrix is
+ONE test function sharing a single chaos driver invocation.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from jaxtlc.engine import checkpoint as ck
+from jaxtlc.engine.fpset import BUCKET, mix_host, mix_host_np
+from jaxtlc.engine.spill import (
+    SpillStore,
+    save_snapshot,
+    spill_sibling,
+)
+from jaxtlc.resil import (
+    AllocDeniedFault,
+    FaultPlan,
+    SupervisorOptions,
+    is_resource_exhausted,
+    supervise,
+)
+from jaxtlc.resil.faults import FaultInjector, TransientFault
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name,
+        os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     f"{name}.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---- host-store units (no engine builds) ---------------------------------
+
+
+def test_mix_host_np_matches_scalar():
+    lo = (np.arange(64, dtype=np.uint32) * np.uint32(2654435761)) + 3
+    hi = (np.arange(64, dtype=np.uint32) * np.uint32(40503)) ^ 0xBEEF
+    mlo, mhi = mix_host_np(lo, hi)
+    for i in range(64):
+        assert (int(mlo[i]), int(mhi[i])) == mix_host(int(lo[i]),
+                                                      int(hi[i]))
+
+
+def test_spill_store_insert_probe_grow():
+    s = SpillStore(capacity=BUCKET * 2)  # 16 slots: forces growth
+    lo = np.arange(100, dtype=np.uint32)
+    hi = lo * np.uint32(977)
+    assert not s.probe(lo, hi).any()
+    assert s.insert_batch(lo, hi) == 100
+    assert s.count == 100 and s.capacity >= 128  # grew past highwater
+    assert s.probe(lo, hi).all()
+    # idempotent re-insert (the replay-overlap case)
+    assert s.insert_batch(lo, hi) == 0
+    assert s.count == 100
+    # absent fingerprints stay absent
+    assert not s.probe(lo + np.uint32(1000), hi).any()
+    # the raw (0,0) fingerprint maps through the device remap class
+    z = np.zeros(1, np.uint32)
+    s.insert_batch(z, z)
+    assert s.probe(z, z).all()
+
+
+def test_spill_store_snapshot_restore_deterministic():
+    a, b = SpillStore(1 << 8), SpillStore(1 << 8)
+    lo = np.arange(50, dtype=np.uint32) + 7
+    hi = lo * np.uint32(31)
+    a.insert_batch(lo, hi)
+    b.insert_batch(lo, hi)
+    # identical insert order -> identical table bytes (determinism the
+    # bit-for-bit resume contract rests on)
+    assert (a.table == b.table).all()
+    snap = a.snapshot()
+    a.insert_batch(lo + np.uint32(500), hi)
+    assert a.count == 100
+    a.restore(snap)
+    assert a.count == 50 and (a.table == b.table).all()
+    assert a.probe(lo, hi).all()
+    assert not a.probe(lo + np.uint32(500), hi).any()
+
+
+def test_spill_store_save_load_crc(tmp_path):
+    s = SpillStore(1 << 8)
+    lo = np.arange(40, dtype=np.uint32) + 1
+    s.insert_batch(lo, lo * np.uint32(13))
+    path = spill_sibling(str(tmp_path / "c.npz"))
+    s.save(path)
+    loaded = SpillStore.load(path)
+    assert loaded.count == s.count
+    assert (loaded.table == s.table).all()
+    assert loaded.probe(lo, lo * np.uint32(13)).all()
+    # snapshots persist the BOUNDARY state, not the live store
+    snap = s.snapshot()
+    s.insert_batch(lo + np.uint32(100), lo)
+    save_snapshot(path, snap)
+    assert SpillStore.load(path).count == 40
+    # a torn file is a loud CheckpointCorruptError, never garbage
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2: len(data) // 2 + 8] = b"\xff" * 8
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(ck.CheckpointCorruptError):
+        SpillStore.load(path)
+
+
+def test_fpset_member_filter():
+    import jax.numpy as jnp
+
+    from jaxtlc.engine.fpset import (
+        fpset_insert,
+        fpset_member,
+        fpset_new,
+    )
+
+    s = fpset_new(1 << 9)
+    lo = jnp.arange(200, dtype=jnp.uint32)
+    hi = lo * jnp.uint32(7919)
+    s, is_new = fpset_insert(s, lo, hi, jnp.ones(200, bool))
+    assert bool(is_new.all())
+    # complete: every stored fingerprint is found
+    assert bool(fpset_member(s, lo, hi, jnp.ones(200, bool)).all())
+    # sound: absent fingerprints are never claimed present
+    assert not bool(
+        fpset_member(s, lo + 5000, hi, jnp.ones(200, bool)).any()
+    )
+    # masked lanes never resolve to present
+    assert not bool(fpset_member(s, lo, hi, jnp.zeros(200, bool)).any())
+
+
+# ---- fault DSL + error classification ------------------------------------
+
+
+def test_fault_plan_parses_ladder_entries():
+    plan = FaultPlan.parse("alloc_fail@1,spill_fail@2,sigterm@3")
+    assert plan.alloc_fail == {1} and plan.spill_fail == {2}
+    inj = FaultInjector(plan)
+    with pytest.raises(MemoryError, match="RESOURCE_EXHAUSTED"):
+        inj.alloc_probe()
+    inj.alloc_probe()  # fires exactly once
+    inj.spill_write()
+    with pytest.raises(OSError, match="spill-write"):
+        inj.spill_write()
+    inj.spill_write()
+
+
+def test_resource_exhausted_classification():
+    assert is_resource_exhausted(AllocDeniedFault("probe denied"))
+    assert is_resource_exhausted(MemoryError())
+    assert not is_resource_exhausted(TransientFault("flaky link"))
+    # the XLA status-string path (whatever concrete runtime-error type
+    # this jaxlib raises, the supervisor classifies by message)
+    try:
+        from jax.errors import JaxRuntimeError
+
+        e = JaxRuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 2147483648 "
+            "bytes"
+        )
+        assert is_resource_exhausted(e)
+        assert not is_resource_exhausted(
+            JaxRuntimeError("INTERNAL: device lost")
+        )
+    except (ImportError, TypeError):  # pragma: no cover
+        pass
+
+
+class _OOMAdapter:
+    """Pure-python adapter whose segment always dies with a
+    RESOURCE_EXHAUSTED: the supervisor must route it to the ladder
+    (rung 4 here - nothing is shrinkable) WITHOUT burning the retry
+    budget (the PR 2 transient-overreach fix)."""
+
+    kind = "stub"
+    GEOM_KEYS = ()
+    FIXED_KEYS = ("format",)
+
+    def __init__(self):
+        self.attempts = 0
+
+    def build(self, params, ckpt_every):
+        template = {"x": np.zeros(2, np.int32)}
+
+        def seg(c):
+            self.attempts += 1
+            raise AllocDeniedFault("segment arena exhausted")
+
+        return template, seg
+
+    def meta(self, params):
+        return {"format": ck.FORMAT_VERSION}
+
+    def viol(self, carry):
+        return 0
+
+    def done(self, carry):
+        return False
+
+    def progress(self, carry):
+        return (0, 0, 0, 0)
+
+    def migrate(self, carry, old, new):  # pragma: no cover
+        raise AssertionError("nothing to regrow")
+
+    def result(self, carry, wall, segments, params):
+        from jaxtlc.engine.bfs import CheckResult
+
+        return CheckResult(0, 0, 0, 0, 0, "none", np.zeros(1), -1, {},
+                           {}, wall, segments)
+
+
+def test_oom_goes_to_ladder_not_retry_budget():
+    adapter = _OOMAdapter()
+    events = []
+    sr = supervise(
+        adapter, {},
+        SupervisorOptions(retries=2, backoff_base_s=0.01,
+                          on_event=lambda k, i: events.append((k, i))),
+    )
+    # ONE attempt, zero retries, exhausted verdict - not three timed-out
+    # backoff rounds followed by a crash
+    assert adapter.attempts == 1
+    assert sr.retries == 0
+    assert sr.exhausted and sr.interrupted
+    kinds = [k for k, _ in events]
+    assert "degrade" in kinds and "exhausted" in kinds
+    assert "retry" not in kinds
+    assert [i for k, i in events if k == "final"][-1]["verdict"] == \
+        "exhausted"
+
+
+def test_transient_still_retries():
+    """The classification must not over-rotate: non-OOM runtime errors
+    keep the backoff path."""
+
+    class _FlakyAdapter(_OOMAdapter):
+        def build(self, params, ckpt_every):
+            template = {"x": np.zeros(2, np.int32)}
+
+            def seg(c):
+                self.attempts += 1
+                if self.attempts == 1:
+                    raise TransientFault("flaky interconnect")
+                return c
+
+            return template, seg
+
+        def done(self, carry):
+            return self.attempts >= 2
+
+    adapter = _FlakyAdapter()
+    sr = supervise(
+        adapter, {}, SupervisorOptions(retries=2, backoff_base_s=0.01),
+    )
+    assert sr.retries == 1 and not sr.exhausted
+
+
+# ---- the ladder matrix (the ISSUE 7 acceptance pin) ----------------------
+
+
+def test_ladder_matrix_acceptance(tmp_path):
+    """Every rung of the degradation ladder, bit-for-bit: regrow denied
+    -> spill completes; spill + SIGTERM -> -recover restores both
+    tiers; spill write fails -> checkpoint + exhausted -> resume
+    completes.  One chaos-driver invocation covers the whole matrix
+    (tier-1 engine-compile budget)."""
+    chaos = _load_tool("chaos")
+    rc, det = chaos.run_matrix(
+        tiny=True, verbose=False, artifacts_dir=str(tmp_path)
+    )
+    assert rc == 0, det
+
+    sc = det["scenarios"]
+    # the recovered-through-both-tiers run IS the clean signature
+    assert sc["spill-recover"]["sig"] == det["clean_sig"]
+    assert sc["spill-sigterm"]["spilled"] > 0
+    assert sc["spill-fail"]["exhausted"]
+
+    # the journal is schema-valid end to end (validate=True raises on
+    # any drift) and carries the new event kinds
+    from jaxtlc.obs import journal as jr
+
+    events = jr.read(det["journal_path"])  # validates every line
+    kinds = {e["event"] for e in events}
+    assert {"spill", "degrade", "level", "interrupted"} <= kinds
+    # spill occupancy: activation + flushes with store state
+    flushes = [e for e in events
+               if e["event"] == "spill" and e["phase"] == "flush"]
+    assert flushes and flushes[-1]["spilled"] > 0
+    # the counter ring's COL_SPILL column surfaced on level events
+    assert any("spill_hits" in e for e in events
+               if e["event"] == "level")
+
+    # and the operator dashboard renders the tier
+    tlcstat = _load_tool("tlcstat")
+    frame = tlcstat.render(events)
+    assert "spill tier:" in frame and "degrades" in frame
+    assert "(spilling)" in frame
+
+
+@pytest.mark.slow
+def test_spill_model1_scale():
+    """Model_1 through the spill tier: regrow denied at 2^17 leaves the
+    device table 1/2 the distinct-state count; the host tier absorbs
+    the rest and the counts match the committed MC.out reference."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jaxtlc.config import MODEL_1
+    from jaxtlc.resil import check_supervised
+
+    # queue sized generously so the FIRST regrow probe is the fpset's
+    # (the denial must land on the spillable resource)
+    sr = check_supervised(
+        MODEL_1, chunk=1024, queue_capacity=1 << 13,
+        fp_capacity=1 << 17,
+        opts=SupervisorOptions(
+            ckpt_every=64, faults=FaultPlan.parse("alloc_fail@1"),
+        ),
+    )
+    r = sr.result
+    assert (r.generated, r.distinct, r.depth) == (577736, 163408, 124)
+    assert r.violation == 0 and r.queue_left == 0
+    assert sr.spilled > 0 and not sr.exhausted

@@ -14,9 +14,22 @@ Usage:
     python tools/chaos.py --plan PLAN     # custom fault plan (faults DSL)
     python tools/chaos.py --seed-caps     # also run the undersized-regrow
                                           # scenario from 1/8 capacities
+    python tools/chaos.py --matrix --tiny # degradation-ladder matrix:
+                                          # every rung of the capacity
+                                          # ladder pinned bit-for-bit
 
 The smoke mode is wired into tier-1 (tests/test_resil.py::test_chaos_smoke)
-so every recovery path stays proven on every run of the suite.
+and the ladder matrix into tests/test_spill.py, so every recovery path
+stays proven on every run of the suite.
+
+The ladder matrix (ISSUE 7): each scenario denies a capacity-recovery
+step by fault injection and verifies the supervisor lands on the NEXT
+rung with clean-run-exact final statistics:
+
+    regrow denied (alloc_fail@1)   -> host spill tier completes the run
+    spill + SIGTERM                -> -recover restores BOTH tiers
+    spill write fails (spill_fail) -> checkpoint + exhausted (exit 75),
+                                      resume completes
 """
 
 from __future__ import annotations
@@ -162,16 +175,177 @@ def run_scenarios(plan_spec: str = "", verbose: bool = True) -> int:
     return 0
 
 
+def run_matrix(tiny: bool = True, verbose: bool = True,
+               artifacts_dir: str = None):
+    """The degradation-ladder matrix: every rung triggered by injected
+    faults, every recovered run verified bit-for-bit against a clean
+    run at the SAME chunk (chunk batching shapes in-batch attribution,
+    so the reference must match it).  Returns (rc, details): details
+    carries per-scenario signatures, captured journal events, and the
+    spill scenario's journal path (tests assert schema validity and
+    the tlcstat rendering on it).
+
+    `tiny` picks the FF corner at small capacities (the tier-1 wiring;
+    there is no big mode yet - the flag keeps the CLI contract stable
+    when a Model_1-scale matrix lands behind it)."""
+    import contextlib
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jaxtlc.config import ModelConfig
+    from jaxtlc.engine.bfs import check
+    from jaxtlc.obs.journal import RunJournal
+    from jaxtlc.resil import (
+        FaultPlan,
+        SupervisorOptions,
+        check_supervised,
+    )
+
+    cfg = ModelConfig(False, False)  # FF corner: 17020/8203/109
+    chunk = 64 if tiny else 128
+    # undersized on purpose; the counter ring rides along so the
+    # spill-hit column (obs COL_SPILL) lands in the level events
+    caps = dict(chunk=chunk, queue_capacity=1 << 7,
+                fp_capacity=1 << 11, obs_slots=32)
+
+    def say(msg):
+        if verbose:
+            print(f"[chaos-matrix] {msg}", flush=True)
+
+    say(f"clean reference (chunk={chunk})...")
+    clean = check(cfg, chunk=chunk, queue_capacity=1 << 12,
+                  fp_capacity=1 << 14)
+    details = {"clean_sig": _sig(clean), "scenarios": {}}
+    failures = []
+
+    def run(name, faults, ckpt, journal=None, resume=False):
+        events = []
+
+        def on_event(kind, info):
+            if journal is not None:
+                events.append(journal.event(kind, **info))
+            else:
+                events.append({"event": kind, **info})
+
+        sr = check_supervised(
+            cfg, opts=SupervisorOptions(
+                ckpt_path=ckpt, ckpt_every=8, resume=resume,
+                faults=FaultPlan.parse(faults) if faults else None,
+                on_event=on_event,
+            ), **caps,
+        )
+        details["scenarios"][name] = {
+            "sig": _sig(sr.result), "events": events,
+            "regrows": sr.regrows, "spilled": sr.spilled,
+            "spill_flushes": sr.spill_flushes,
+            "spill_hits": sr.spill_hits,
+            "interrupted": sr.interrupted, "exhausted": sr.exhausted,
+        }
+        return sr
+
+    def verify(name, sr, want_complete=True):
+        if want_complete and _sig(sr.result) != details["clean_sig"]:
+            failures.append(f"{name}(signature mismatch)")
+            say(f"FAIL {name}: {_sig(sr.result)} != "
+                f"{details['clean_sig']}")
+        elif want_complete:
+            say(f"ok   {name} (regrows={sr.regrows} "
+                f"spilled={sr.spilled} flushes={sr.spill_flushes} "
+                f"hits={sr.spill_hits})")
+
+    own_dir = None
+    if artifacts_dir is None:
+        own_dir = tempfile.TemporaryDirectory()
+        artifacts_dir = own_dir.name
+    with contextlib.ExitStack() as stack:
+        if own_dir is not None:
+            stack.enter_context(own_dir)
+
+        # rung 2 + recover: regrow denied -> spill tier; SIGTERM mid-
+        # spill -> drain; -recover restores BOTH tiers and completes
+        # with clean statistics (undersized queue also forces a queue
+        # regrow WHILE the spill tier is active)
+        say("scenario: regrow denied -> spill; SIGTERM; recover...")
+        ck1 = os.path.join(artifacts_dir, "ladder-spill.npz")
+        jpath = ck1 + ".journal.jsonl"
+        j = stack.enter_context(RunJournal(jpath))
+        sr = run("spill-sigterm", "alloc_fail@1,sigterm@6", ck1,
+                 journal=j)
+        sc = details["scenarios"]["spill-sigterm"]
+        if not sr.interrupted:
+            failures.append("spill-sigterm(not interrupted)")
+        if sr.spilled == 0:
+            failures.append("spill-sigterm(spill tier never activated)")
+        if not os.path.exists(ck1 + ".spill"):
+            failures.append("spill-sigterm(no host-tier sibling file)")
+        j.event("run_resume", version="chaos-matrix", path=jpath)
+        sr = run("spill-recover", "", ck1, journal=j, resume=True)
+        # the undersized queue must have regrown WHILE the spill tier
+        # was active, in whichever attempt the wide level landed in
+        # (the grown geometry travels inside the checkpoint)
+        if sc["regrows"] + sr.regrows == 0:
+            failures.append(
+                "spill-recover(no queue regrow under spill)"
+            )
+        verify("spill-recover", sr)
+        details["journal_path"] = jpath
+
+        # rung 4: the spill write itself fails -> checkpoint +
+        # exhausted (exit 75 at the CLI) with a verified resumable
+        # generation on disk (the resume path itself is the one
+        # spill-recover just proved; re-running it would only re-pay
+        # an engine compile against the tier-1 wall-clock budget)
+        say("scenario: spill write fails -> exhausted...")
+        ck2 = os.path.join(artifacts_dir, "ladder-exhaust.npz")
+        sr = run("spill-fail", "alloc_fail@1,spill_fail@1", ck2)
+        if not (sr.exhausted and sr.interrupted):
+            failures.append("spill-fail(did not exhaust)")
+        if not any(e["event"] == "exhausted"
+                   for e in details["scenarios"]["spill-fail"]["events"]):
+            failures.append("spill-fail(no exhausted event)")
+        from jaxtlc.engine.checkpoint import (
+            list_generations,
+            read_checkpoint_meta,
+        )
+
+        gens = list_generations(ck2)
+        if not gens:
+            failures.append("spill-fail(no checkpoint generation)")
+        else:
+            meta = read_checkpoint_meta(gens[-1][1])
+            if not (meta.get("spill") or {}).get("active"):
+                failures.append("spill-fail(meta lost the spill tier)")
+
+        if sc["spill_hits"] == 0 and \
+                details["scenarios"]["spill-recover"]["spill_hits"] == 0:
+            failures.append("matrix(host tier never vetoed a candidate)")
+
+    if failures:
+        say(f"FAILURES: {failures}")
+        return 1, details
+    say("ladder matrix: every rung recovered to exact clean statistics")
+    return 0, details
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="fault-injection chaos driver for the run supervisor"
     )
     p.add_argument("--smoke", action="store_true",
                    help="fast fixed-plan CPU run (the tier-1 wiring)")
+    p.add_argument("--matrix", action="store_true",
+                   help="degradation-ladder matrix: deny each capacity-"
+                        "recovery step by fault injection, verify the "
+                        "next rung lands bit-for-bit on clean stats")
+    p.add_argument("--tiny", action="store_true",
+                   help="with --matrix: the FF-corner tier-1 wiring")
     p.add_argument("--plan", default="",
                    help="extra fault plan DSL for a custom scenario")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
+    if args.matrix:
+        rc, _ = run_matrix(tiny=args.tiny, verbose=not args.quiet)
+        return rc
     return run_scenarios(plan_spec=args.plan, verbose=not args.quiet)
 
 

@@ -91,9 +91,16 @@ def render(events) -> str:
             f"({dpm:,} ds/min)"
         )
         occ = cur.get("fp_load")
+        # with the host spill tier active, distinct states exceed the
+        # DEVICE table: the ratio is the logical set vs the hot tier
+        spilling = any(e["event"] == "spill" for e in events)
+        occ_txt = ""
+        if occ is not None:
+            occ_txt = (f"  |  fp space {occ:.1%} of device tier "
+                       "(spilling)" if spilling
+                       else f"  |  fp table {occ:.1%} full")
         lines.append(
-            f"queue {cur['queue']:,}"
-            + (f"  |  fp table {occ:.1%} full" if occ is not None else "")
+            f"queue {cur['queue']:,}" + occ_txt
             + f"  |  ETA (queue drain) {_fmt_eta(eta_s(prev, cur))}"
         )
     counts = {}
@@ -105,7 +112,22 @@ def render(events) -> str:
         f"  regrows {counts.get('regrow', 0)}"
         f"  retries {counts.get('retry', 0)}"
         f"  interruptions {counts.get('interrupted', 0)}"
+        f"  degrades {counts.get('degrade', 0)}"
     )
+    # host spill tier: occupancy + hit rate of the most recent spill
+    # event (the device tier's cold-fingerprint overflow store)
+    sp = next((e for e in reversed(events) if e["event"] == "spill"),
+              None)
+    if sp is not None:
+        probes = max(sp.get("probes", 0), 1)
+        lines.append(
+            f"spill tier: {sp['spilled']:,} fps host-side "
+            f"({sp['spilled'] / max(sp['capacity'], 1):.1%} of "
+            f"{sp['capacity']:,})  |  flushes "
+            f"{max(counts.get('spill', 1) - 1, 0)}  |  host hit-rate "
+            f"{sp.get('hits', 0) / probes:.1%} of {sp.get('probes', 0):,}"
+            " probes"
+        )
     last = events[-1]
     age = time.time() - last["t"]
     lines.append(f"last event: {last['event']} ({age:.1f}s ago)")
