@@ -456,7 +456,14 @@ def bench_obs_ab(probe_err: str) -> int:
     CPU benchmark) plus the standard rate line for the obs-on engine.
     Both engines are AOT-compiled ONCE and the timed runs interleave
     (off/on per repeat, best-of-5): single-digit-percent CPU timer
-    drift otherwise dominates the effect being measured."""
+    drift otherwise dominates the effect being measured.
+
+    ISSUE 8 extension: a second interleaved best-of-5 A/B over the SAME
+    compiled segment stepper measures the fence-mode phase-timing tier
+    (obs.phases.segment_phases -> fsync'd `phase` journal events) WITH
+    a live obs.serve monitor + /events SSE subscriber attached, vs the
+    bare stepped loop.  Gate: bit-for-bit finals again;
+    `phase_overhead_pct` (acceptance: <= 0.5%) rides the obs payload."""
     device_note = ""
     if probe_err:
         import jax
@@ -519,6 +526,111 @@ def bench_obs_ab(probe_err: str) -> int:
                         "engine", "workload": workload})
         return 1
 
+    # ---- phase-timing + live-subscriber A/B (ISSUE 8) -----------------
+    # Same compiled engine, driven in fixed segments: loop A is the bare
+    # stepper, loop B adds exactly what a monitored run adds - fence
+    # timestamps, schema-validated fsync'd `phase`/`segment` journal
+    # events, a live obs.serve server and an SSE /events subscriber.
+    import tempfile
+    import threading
+    import urllib.request
+
+    from jaxtlc.engine.bfs import carry_done, make_engine as _mk
+    from jaxtlc.obs.journal import RunJournal
+    from jaxtlc.obs.phases import segment_phases
+    from jaxtlc.obs.serve import start_server
+
+    init_fn, _, step_fn = _mk(MODEL_1, **kw, obs_slots=256,
+                              donate=False)
+    from jax import lax
+
+    @jax.jit
+    def seg_fn(c):
+        return lax.fori_loop(0, 64, lambda _, cc: step_fn(cc), c)
+
+    carry0 = init_fn()
+    seg_c = seg_fn.lower(carry0).compile()
+
+    tmpdir = tempfile.mkdtemp(prefix="obs-ab-")
+    jpath = f"{tmpdir}/ab.journal.jsonl"
+    journal = RunJournal(jpath)
+    journal.event("run_start", version="bench", workload=workload,
+                  engine="single", device=str(jax.devices()[0]),
+                  params=dict(kw))
+    server = start_server(tmpdir)
+    sse_seen = [0]
+
+    def subscribe():
+        try:
+            with urllib.request.urlopen(server.url + "/events",
+                                        timeout=60) as r:
+                while True:
+                    line = r.readline()
+                    if not line:
+                        return
+                    if line.startswith(b"data: "):
+                        sse_seen[0] += 1
+        except OSError:
+            pass
+
+    sub = threading.Thread(target=subscribe, daemon=True)
+    sub.start()
+
+    def run_plain():
+        c = carry0
+        t0 = time.time()
+        while True:
+            c = jax.block_until_ready(seg_c(c))
+            if carry_done(c):
+                break
+        return time.time() - t0, c
+
+    def run_phased():
+        c = carry0
+        seg_i = 0
+        t0 = time.time()
+        while True:
+            t_d = time.time()
+            c = jax.block_until_ready(seg_c(c))
+            t_f = time.time()
+            journal.event("segment", index=seg_i, t_dispatch=t_d,
+                          t_fence=t_f, wall_s=round(t_f - t_d, 6))
+            for row in segment_phases(seg_i, t_f - t_d):
+                journal.event("phase", **row)
+            seg_i += 1
+            if carry_done(c):
+                break
+        return time.time() - t0, c
+
+    ab_walls = {"plain": [], "phased": []}
+    ab_finals = {}
+    for _ in range(5):
+        for name, fn in (("plain", run_plain), ("phased", run_phased)):
+            w, out = fn()
+            ab_walls[name].append(w)
+            ab_finals[name] = out
+    time.sleep(0.5)  # let the subscriber drain the tail
+    server.shutdown()
+    journal.close()
+
+    ok_phase = signature(
+        result_from_carry(ab_finals["plain"], 0.0,
+                          fp_capacity=kw["fp_capacity"])
+    ) == signature(
+        result_from_carry(ab_finals["phased"], 0.0,
+                          fp_capacity=kw["fp_capacity"])
+    ) and (
+        np.asarray(ab_finals["plain"].fps.table)
+        == np.asarray(ab_finals["phased"].fps.table)
+    ).all()
+    if not ok_phase:
+        _emit({"error": "phase-timed run is not bit-identical to the "
+                        "bare stepped engine", "workload": workload})
+        return 1
+    phase_overhead_pct = 100.0 * (
+        min(ab_walls["phased"]) - min(ab_walls["plain"])
+    ) / min(ab_walls["plain"])
+
     wall_off, wall_on = min(walls[0]), min(walls[256])
     overhead_pct = 100.0 * (wall_on - wall_off) / wall_off
     device = str(jax.devices()[0]) + device_note
@@ -533,6 +645,10 @@ def bench_obs_ab(probe_err: str) -> int:
             "wall_s_no_obs": round(wall_off, 3),
             "rate_obs": round(results[256].distinct / wall_on, 1),
             "rate_no_obs": round(results[0].distinct / wall_off, 1),
+            "phase_overhead_pct": round(phase_overhead_pct, 3),
+            "wall_s_phase": round(min(ab_walls["phased"]), 3),
+            "wall_s_no_phase": round(min(ab_walls["plain"]), 3),
+            "sse_events_seen": sse_seen[0],
             "repeats": 5,
             "device": device,
         }

@@ -435,6 +435,7 @@ def _sup_opts(args, log):
         ckpt_every=args.checkpointevery,
         resume=args.recover,
         spill=args.spill,
+        phase_timing=args.phasetiming,
         faults=FaultPlan.parse(args.faults) if args.faults else None,
         on_event=on_event,
     )
@@ -462,6 +463,15 @@ def _open_journal(args, workload: str, engine: str, device: str,
     path = args.journal or (
         args.checkpoint + ".journal.jsonl" if args.checkpoint else ""
     )
+    if not path and args.serve:
+        # the monitor serves journal FILES; an unjournaled -serve run
+        # gets one beside the temp dir (printed below via the server)
+        import tempfile
+
+        path = os.path.join(
+            tempfile.gettempdir(),
+            f"jaxtlc-{os.getpid()}.journal.jsonl",
+        )
     resume = bool(args.recover and path and os.path.exists(path))
     j = RunJournal(path or None, resume=resume)
     if resume:
@@ -470,6 +480,17 @@ def _open_journal(args, workload: str, engine: str, device: str,
         j.event("run_start", version=_v, workload=workload,
                 engine=engine, device=device, params=params)
     args._journal = j
+    if args.serve:
+        # live ops plane: /metrics + /events (SSE) + /runs over this
+        # run's journal directory for the run's whole lifetime
+        from .obs.serve import start_server
+
+        args._server = start_server(
+            os.path.dirname(os.path.abspath(path)) or ".",
+            port=args.serve,
+        )
+        print(f"jaxtlc monitor at {args._server.url} "
+              "(/runs /metrics /events /journal)", file=sys.stderr)
     return j
 
 
@@ -508,6 +529,10 @@ def _finish_journal(args, log, r=None, sup=None, verdict: str = None,
     finally:
         j.close()
         args._journal = None
+        server = getattr(args, "_server", None)
+        if server is not None:
+            server.shutdown()
+            args._server = None
 
 
 def _resume_command(args) -> str:
@@ -1192,6 +1217,27 @@ def main(argv=None) -> int:
                         "-checkpoint is set; -recover APPENDS, so an "
                         "interrupted+resumed run has ONE journal.  "
                         "tools/tlcstat.py tails it live")
+    c.add_argument("-serve", dest="serve", type=int, default=0,
+                   metavar="PORT",
+                   help="run the live monitor server on PORT for the "
+                        "whole run: /metrics (Prometheus text), "
+                        "/events (SSE journal tail, survives "
+                        "interrupt+-recover as one stream), /runs "
+                        "(registry), /journal (raw; tools/tlcstat.py "
+                        "--connect renders it).  python -m "
+                        "jaxtlc.obs.serve serves existing journals "
+                        "standalone")
+    c.add_argument("-phase-timing", dest="phasetiming",
+                   action="store_true",
+                   help="measured per-level expand/commit walls: the "
+                        "supervisor swaps the fused segment dispatch "
+                        "for a host-fenced step loop built from the "
+                        "same stage closures (bit-for-bit results), "
+                        "journaling `phase` events the trace exporter "
+                        "renders as measured lanes.  Costs a fence per "
+                        "step (PERF.md round 11); unpipelined single-"
+                        "device engines only - other paths keep the "
+                        "free segment-scope attribution")
     c.add_argument("-trace-out", dest="traceout", default="",
                    metavar="FILE",
                    help="export the run timeline as a Chrome-trace JSON "

@@ -134,6 +134,16 @@ class ShardCarry(NamedTuple):
     obs_head: jnp.ndarray = None  # [D] int32 rows ever written
     obs_bodies: jnp.ndarray = None  # [D] uint32 loop bodies
     obs_expanded: jnp.ndarray = None  # [D] uint32 states popped
+    # --- deferred obs row (pipeline x obs only) ------------------------
+    # In pipeline mode the flip body's act_dist is still missing its
+    # last chunk's verdicts (they are pending in pv_*), so the level-
+    # flip ring row is written one body LATE - right after the deferred
+    # verdict fold completes the counters.  Every other row column is a
+    # cumulative counter whose next-body ENTRY value equals the flip
+    # body's exit value, so only the flip's level (and a staged flag)
+    # ride the carry.  Fixes the PR 5 documented per-level act_dist lag.
+    obs_pl_level: jnp.ndarray = None  # [D] int32 staged flip's level
+    obs_pl_flag: jnp.ndarray = None  # [D] bool a flip row is staged
 
 
 def route_bucket_width(chunk: int, n_lanes: int, D: int,
@@ -191,9 +201,11 @@ def make_sharded_engine(
     (obs.counters): one partial-counter row per global level flip,
     summed host-side.  Pure telemetry - no control flow reads it - so
     results with obs on are bit-for-bit those of an obs-off run.  In
-    pipeline mode the per-level act_dist/outdegree attribution lags one
-    chunk (the deferred verdict exchange); cumulative totals catch up
-    at the next row.
+    pipeline mode the flip row is written one body LATE, after the
+    deferred verdict exchange folds the flip chunk's stats, so
+    per-level act_dist attributes to the correct level (the PR 5
+    documented lag, since fixed; the deferred-row leaves on ShardCarry
+    carry the staged flip across the body boundary).
     """
     from ..obs.counters import (
         pack_row,
@@ -273,6 +285,11 @@ def make_sharded_engine(
                 obs_bodies=jnp.zeros(D, jnp.uint32),
                 obs_expanded=jnp.zeros(D, jnp.uint32),
             )
+            if pipeline:
+                obs.update(
+                    obs_pl_level=jnp.zeros(D, jnp.int32),
+                    obs_pl_flag=jnp.zeros(D, bool),
+                )
         return ShardCarry(
             table=jnp.asarray(table),
             queue=jnp.asarray(queue),
@@ -513,19 +530,53 @@ def make_sharded_engine(
                 (obs_bodies, c.obs_bodies[0]),
                 (obs_expanded, c.obs_expanded[0]),
             ])
-            row = pack_row(
-                level, generated, distinct, qtail - qhead, obs_bodies,
-                obs_expanded, act_gen[:n_labels], act_dist[:n_labels],
-                overflow=sticky_overflow(c.obs_ring[0], wrapped),
-            )
-            ring, rhead = ring_update(
-                c.obs_ring[0], c.obs_head[0], row, adv & level_done
-            )
-            obs2 = dict(
-                obs_ring=ring[None], obs_head=rhead[None],
-                obs_bodies=obs_bodies[None],
-                obs_expanded=obs_expanded[None],
-            )
+            if pipeline:
+                # deferred-row scheme (ShardCarry docstring): write the
+                # PREVIOUS body's staged flip row now - its lagging
+                # act_dist just completed via the verdict fold at the
+                # top of this body (act_dist0) - and stage this body's
+                # flip.  Every other column is a cumulative counter
+                # whose entry value here equals the flip body's exit
+                # value, so the row is exact per-level attribution.
+                row = pack_row(
+                    c.obs_pl_level[0], c.generated[0], c.distinct[0],
+                    c.qtail[0] - c.qhead[0], c.obs_bodies[0],
+                    c.obs_expanded[0], c.act_gen[0][:n_labels],
+                    act_dist0[:n_labels],
+                    overflow=sticky_overflow(c.obs_ring[0], wrapped),
+                )
+                ring, rhead = ring_update(
+                    c.obs_ring[0], c.obs_head[0], row, c.obs_pl_flag[0]
+                )
+                # only a body that globally popped can NEWLY flip: the
+                # gate keeps no-op iterations (segment mode, the drain
+                # body) from re-staging an already-written flip
+                stage = (adv & level_done
+                         & (lax.psum(n, axis) > 0))
+                obs2 = dict(
+                    obs_ring=ring[None], obs_head=rhead[None],
+                    obs_bodies=obs_bodies[None],
+                    obs_expanded=obs_expanded[None],
+                    obs_pl_level=jnp.where(
+                        stage, level, c.obs_pl_level[0]
+                    )[None],
+                    obs_pl_flag=stage[None],
+                )
+            else:
+                row = pack_row(
+                    level, generated, distinct, qtail - qhead,
+                    obs_bodies, obs_expanded, act_gen[:n_labels],
+                    act_dist[:n_labels],
+                    overflow=sticky_overflow(c.obs_ring[0], wrapped),
+                )
+                ring, rhead = ring_update(
+                    c.obs_ring[0], c.obs_head[0], row, adv & level_done
+                )
+                obs2 = dict(
+                    obs_ring=ring[None], obs_head=rhead[None],
+                    obs_bodies=obs_bodies[None],
+                    obs_expanded=obs_expanded[None],
+                )
         pv2 = {}
         if pipeline:
             # a popped chunk leaves its verdicts pending: keep the loop
@@ -586,6 +637,10 @@ def make_sharded_engine(
             for f in ("obs_ring", "obs_head", "obs_bodies",
                       "obs_expanded")
         })
+        if pipeline:
+            pv_specs.update(
+                obs_pl_level=P(axis), obs_pl_flag=P(axis)
+            )
     specs = ShardCarry(
         table=P(axis),
         queue=P(axis),

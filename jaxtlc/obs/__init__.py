@@ -15,6 +15,14 @@ Three tiers, one source of truth:
 * **Timeline tier** (obs.trace): Chrome-trace/Perfetto export of the
   journal (`-trace-out`), plus the `-xprof DIR` jax.profiler hook in
   the CLI for ground-truth device timelines.
+
+The live ops plane rides on top (ISSUE 8): **phase attribution**
+(obs.phases - free segment-scope walls at every fence, measured
+per-level expand/commit walls behind `-phase-timing`) and the
+**run-monitoring server** (obs.serve - /metrics Prometheus text,
+/events SSE journal tail, /runs registry; `-serve PORT` or
+`python -m jaxtlc.obs.serve`), with tools/costmodel.py fitting the
+per-phase cost model from the phase events.
 """
 
 from .counters import (  # noqa: F401
@@ -25,6 +33,7 @@ from .counters import (  # noqa: F401
     shard_rows_from_ring,
 )
 from .journal import RunJournal, read as read_journal  # noqa: F401
+from .phases import PhaseRecorder, segment_phases  # noqa: F401
 from .schema import (  # noqa: F401
     SCHEMA_VERSION,
     JournalSchemaError,

@@ -84,6 +84,15 @@ EVENTS = {
     "final": {"verdict": _STR, "generated": _NUM, "distinct": _NUM,
               "depth": _NUM, "queue": _NUM, "wall_s": _NUM,
               "interrupted": _BOOL},
+    # -- phase attribution (obs.phases) ------------------------------------
+    # one measured wall per (scope, index, phase): scope "segment" rows
+    # come free at the fences the supervisor already pays (phase
+    # "device"/"readback"), scope "level" rows from the -phase-timing
+    # fenced step loop (phase "expand"/"commit", measured walls the
+    # trace exporter renders instead of its schematic lanes), scope
+    # "chunk" from the spill runtime's host-driven loop
+    "phase": {"scope": _STR, "index": _NUM, "phase": _STR,
+              "wall_s": _NUM},
     # -- preflight analysis (jaxtlc.analysis) ------------------------------
     # one event per finding, severity in ("error", "warning", "info")
     "analysis": {"layer": _STR, "check": _STR, "severity": _STR,

@@ -1,0 +1,341 @@
+"""Run-monitoring server: the live serving surface over the run journal.
+
+TLC's value rests partly on its always-on reporting (the reference
+MC.out is 1108 lines of live progress); ours was post-hoc only - the
+journal had to be read after the fact.  This module is the front door
+of the checking-as-a-service direction (ROADMAP #4): a stdlib-only HTTP
+server over a journal file or a directory of them, serving
+
+* ``/metrics`` - Prometheus text format (states/s, distinct, fp load,
+  spill occupancy/hit-rate, queue-drain ETA, per-phase walls) derived
+  by obs.views.metrics_from_events - the SAME arithmetic as the TLC
+  2200 line and tlcstat, so a scrape cannot disagree with the
+  transcript;
+* ``/events`` - Server-Sent-Events tail of the journal (one ``data:``
+  line per event).  Because `-recover` APPENDS to the same journal
+  file, a subscriber that spans a SIGTERM + resume sees ONE continuous
+  stream: run_start ... interrupted ... run_resume ... final.  A torn
+  trailing line (the crash window) is held back until it completes;
+  ``?once=1`` dumps the current events and closes;
+* ``/runs`` - the run registry: every ``*.journal.jsonl`` under the
+  root, with workload/engine/verdict summary - many concurrent runs
+  multiplex through one server (``?run=NAME`` selects on the other
+  endpoints);
+* ``/journal`` - the raw JSONL (tools/tlcstat.py --connect renders its
+  dashboard from this, a remote client of the same views).
+
+Wiring: ``python -m jaxtlc.obs.serve DIR_OR_JOURNAL [--port N]``
+standalone, or CLI ``-serve PORT`` to serve the live run's journal.
+The server is read-only over files the run appends+fsyncs per event,
+so it never blocks the writer.  The /events tail re-reads the file per
+poll - O(file) per tick, fine for the journal sizes a run produces;
+a seek-based tail is the upgrade path if journals grow past that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from . import journal as jr
+from .views import metrics_from_events
+
+JOURNAL_SUFFIX = ".journal.jsonl"
+POLL_S = 0.2
+
+
+def _runs(root: str) -> List[dict]:
+    """The run registry: one row per journal under `root` (or the row
+    of `root` itself when it IS a journal file), newest first."""
+    paths = []
+    if os.path.isdir(root):
+        for name in sorted(os.listdir(root)):
+            if name.endswith(JOURNAL_SUFFIX):
+                paths.append(os.path.join(root, name))
+    elif os.path.exists(root):
+        paths = [root]
+    rows = []
+    for p in paths:
+        try:
+            events = jr.read(p, validate=False)
+        except OSError:
+            continue
+        manifest = next(
+            (e for e in events if e["event"] == "run_start"), None
+        )
+        fin = next(
+            (e for e in reversed(events) if e["event"] == "final"), None
+        )
+        rows.append({
+            "run": os.path.basename(p)[: -len(JOURNAL_SUFFIX)]
+            if p.endswith(JOURNAL_SUFFIX) else os.path.basename(p),
+            "path": p,
+            "events": len(events),
+            "workload": manifest["workload"] if manifest else None,
+            "engine": manifest["engine"] if manifest else None,
+            "verdict": fin["verdict"] if fin else "running",
+            "last_t": events[-1]["t"] if events else None,
+            "resumes": sum(
+                1 for e in events if e["event"] == "run_resume"
+            ),
+        })
+    rows.sort(key=lambda r: r["last_t"] or 0, reverse=True)
+    return rows
+
+
+def prometheus_text(metrics: dict) -> str:
+    """Render the metrics_from_events dict as Prometheus exposition
+    text (flat gauges, one info-style labeled gauge, one labeled gauge
+    per measured phase)."""
+    lines = []
+    info = metrics.get("run_info", {})
+    labels = ",".join(
+        f'{k}="{v}"' for k, v in sorted(info.items()) if v is not None
+    )
+    lines.append("# HELP jaxtlc_run_info run manifest + verdict labels")
+    lines.append("# TYPE jaxtlc_run_info gauge")
+    lines.append(f"jaxtlc_run_info{{{labels}}} 1")
+    for key, val in sorted(metrics.items()):
+        if key == "run_info":
+            continue
+        if key == "phase_wall_seconds":
+            lines.append("# TYPE jaxtlc_phase_wall_seconds counter")
+            for phase, secs in sorted(val.items()):
+                lines.append(
+                    f'jaxtlc_phase_wall_seconds{{phase="{phase}"}} '
+                    f"{secs}"
+                )
+            continue
+        lines.append(f"jaxtlc_{key} {val}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the owning OpsServer stamps these class-wide at construction
+    root: str = "."
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet: the run owns stdout
+        pass
+
+    # -- helpers ---------------------------------------------------------
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _journal_path(self, qs: dict) -> Optional[str]:
+        """Resolve ?run=NAME against the registry (default: the most
+        recently appended journal)."""
+        rows = _runs(self.root)
+        want = qs.get("run", [None])[0]
+        if want is None:
+            return rows[0]["path"] if rows else None
+        for r in rows:
+            if r["run"] == want or r["path"] == want:
+                return r["path"]
+        return None
+
+    # -- endpoints -------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        route = parsed.path.rstrip("/") or "/"
+        try:
+            if route == "/runs":
+                self._send(200, json.dumps(
+                    {"runs": _runs(self.root)}
+                ).encode(), "application/json")
+            elif route == "/metrics":
+                path = self._journal_path(qs)
+                if path is None:
+                    self._send(404, b"no journal\n", "text/plain")
+                    return
+                events = jr.read(path, validate=False)
+                self._send(
+                    200,
+                    prometheus_text(metrics_from_events(events)).encode(),
+                    "text/plain; version=0.0.4",
+                )
+            elif route == "/journal":
+                path = self._journal_path(qs)
+                if path is None:
+                    self._send(404, b"no journal\n", "text/plain")
+                    return
+                events = jr.read(path, validate=False)
+                body = "".join(
+                    json.dumps(e, sort_keys=True) + "\n" for e in events
+                ).encode()
+                self._send(200, body, "application/x-ndjson")
+            elif route == "/events":
+                self._events(qs)
+            elif route == "/":
+                body = (
+                    "jaxtlc run monitor\n"
+                    "  /runs     run registry (JSON)\n"
+                    "  /metrics  Prometheus text   [?run=NAME]\n"
+                    "  /events   SSE journal tail  [?run=NAME]"
+                    "[&once=1][&since=N]\n"
+                    "  /journal  raw JSONL         [?run=NAME]\n"
+                ).encode()
+                self._send(200, body, "text/plain")
+            else:
+                self._send(404, b"unknown endpoint\n", "text/plain")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # subscriber went away mid-write: their call
+
+    def _events(self, qs: dict) -> None:
+        """SSE tail: emit every complete journal line, then poll for
+        appends.  jr.read holds back a torn trailing line until the
+        writer completes it, so a subscriber never sees a partial
+        event (and never sees it twice).  The stream survives the
+        writer's interrupt+`-recover` because resume APPENDS to the
+        same file - one continuous stream per logical run."""
+        path = self._journal_path(qs)
+        if path is None:
+            self._send(404, b"no journal\n", "text/plain")
+            return
+        once = qs.get("once", ["0"])[0] not in ("0", "")
+        emitted = int(qs.get("since", ["0"])[0])
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        # SSE is an unbounded stream: no Content-Length, close delimits
+        self.send_header("Connection", "close")
+        self.end_headers()
+        while not self.server._jaxtlc_shutdown.is_set():
+            try:
+                events = jr.read(path, validate=False)
+            except OSError:
+                events = []
+            for ev in events[emitted:]:
+                data = json.dumps(ev, sort_keys=True)
+                self.wfile.write(f"data: {data}\n\n".encode())
+            if len(events) > emitted:
+                self.wfile.flush()
+            emitted = max(emitted, len(events))
+            if once:
+                return
+            time.sleep(POLL_S)
+
+
+class OpsServer:
+    """A running monitor server (daemon-threaded).  `port=0` binds an
+    ephemeral port; read it back from `.port`."""
+
+    def __init__(self, root: str, port: int = 0,
+                 host: str = "127.0.0.1"):
+        handler = type("BoundHandler", (_Handler,), {"root": root})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd._jaxtlc_shutdown = threading.Event()
+        self.httpd.daemon_threads = True
+        self.root = root
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self.httpd._jaxtlc_shutdown.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def start_server(root: str, port: int = 0,
+                 host: str = "127.0.0.1") -> OpsServer:
+    """Start a monitor server over `root` (a journal file or a
+    directory of them).  Returns the running OpsServer."""
+    return OpsServer(root, port=port, host=host)
+
+
+def _http_get(url: str, timeout: float = 5.0) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _tiny() -> int:
+    """Smoke the whole serving pipeline on a synthetic journal: start a
+    server, hit every endpoint with stdlib urllib, assert the derived
+    views landed (wired into tier-1; no engine, no jax)."""
+    import tempfile
+
+    from .trace import _tiny_journal
+
+    with tempfile.TemporaryDirectory() as d:
+        jpath = os.path.join(d, "tiny.journal.jsonl")
+        _tiny_journal(jpath)
+        srv = start_server(d)
+        try:
+            runs = json.loads(_http_get(srv.url + "/runs"))["runs"]
+            assert len(runs) == 1 and runs[0]["run"] == "tiny", runs
+            assert runs[0]["verdict"] == "interrupted", runs
+            metrics = _http_get(srv.url + "/metrics")
+            for needle in ("jaxtlc_run_info", "jaxtlc_generated_total",
+                           "jaxtlc_distinct_total",
+                           "jaxtlc_spill_occupancy",
+                           "jaxtlc_phase_wall_seconds{phase="):
+                assert needle in metrics, (needle, metrics)
+            sse = _http_get(srv.url + "/events?once=1&run=tiny")
+            datas = [ln for ln in sse.splitlines()
+                     if ln.startswith("data: ")]
+            events = jr.read(jpath, validate=False)
+            assert len(datas) == len(events), (len(datas), len(events))
+            assert '"event": "final"' in datas[-1]
+            raw = _http_get(srv.url + "/journal")
+            assert len(raw.splitlines()) == len(events)
+        finally:
+            srv.shutdown()
+    print(f"serve tiny OK: {len(events)} events served on "
+          f"/runs /metrics /events /journal")
+    return 0
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m jaxtlc.obs.serve DIR_OR_JOURNAL [--port N]``."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="jaxtlc.obs.serve")
+    p.add_argument("root", nargs="?",
+                   help="journal file or a directory of *.journal.jsonl")
+    p.add_argument("--port", type=int, default=8790)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--tiny", action="store_true",
+                   help="smoke: serve a synthetic journal end-to-end "
+                        "(no engine run; wired into tier-1)")
+    args = p.parse_args(argv)
+    if args.tiny:
+        return _tiny()
+    if not args.root:
+        p.error("root path required (or --tiny)")
+    srv = start_server(args.root, port=args.port, host=args.host)
+    print(f"jaxtlc monitor serving {args.root!r} at {srv.url} "
+          "(/runs /metrics /events /journal; ctrl-c exits)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.shutdown()
+        return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

@@ -5,12 +5,15 @@ https://ui.perfetto.dev JSON file (`-trace-out run.trace.json`):
 
 * pid "device": one slice per supervised segment (dispatch -> fence),
   subdivided into per-level expand/commit sub-slices on two threads.
-  Per-level spans are SCHEMATIC - body-count-proportional placement
-  inside the segment's host-observed wall, since the device does not
-  timestamp individual levels - but their overlap structure is real:
-  in pipeline mode the commit lane of level k overlaps the expand lane
-  of level k+1 (the staged-block schedule), in fused mode they abut.
-  Ground-truth device timelines come from `-xprof DIR` (jax.profiler).
+  When the journal carries MEASURED per-level `phase` events (a
+  `-phase-timing` run, obs.phases), the sub-slices use those walls -
+  the lanes are measurement, not illustration.  Without them the
+  per-level spans fall back to the SCHEMATIC body-count-proportional
+  placement inside the segment's host-observed wall; the overlap
+  structure is still real either way: in pipeline mode the commit lane
+  of level k overlaps the expand lane of level k+1 (the staged-block
+  schedule), in fused mode they abut.  Ground-truth device timelines
+  come from `-xprof DIR` (jax.profiler).
 * pid "host": checkpoint-write and regrow-migration slices, plus
   instant markers for retries, faults, interruption, recovery and the
   final verdict - so "why was this segment slow" is one glance (the
@@ -80,21 +83,59 @@ def chrome_trace_events(events: List[dict]) -> List[dict]:
                     "args": args or {}})
 
     # level events journal at the fence AFTER the segment they ran in:
-    # walk in order, buffering levels against the most recent segment
+    # walk in order, buffering levels (and any measured per-level phase
+    # walls) against the most recent segment
     pending_levels: List[dict] = []
+    pending_phases: dict = {}  # level -> {"expand": s, "commit": s}
     last_segment = None
 
     def flush_levels():
-        """Subdivide the last segment's wall among its buffered levels
-        (body-count-proportional), emitting expand/commit sub-slices
-        whose overlap mirrors the engine's step schedule."""
-        nonlocal pending_levels
-        seg, levels = last_segment, pending_levels
+        """Subdivide the last segment's wall among its buffered levels,
+        emitting expand/commit sub-slices whose overlap mirrors the
+        engine's step schedule.  MEASURED placement when the segment's
+        `phase` events cover every buffered level (a -phase-timing run:
+        sequential expand->commit slices of the measured walls);
+        body-count-proportional schematic otherwise."""
+        nonlocal pending_levels, pending_phases
+        seg, levels, phases = last_segment, pending_levels, pending_phases
         pending_levels = []
+        pending_phases = {}
         if seg is None or not levels:
             return
         seg_ts = us(seg["t_dispatch"])
         seg_dur = max(seg["wall_s"] * 1e6, 1.0)
+        measured = all(
+            {"expand", "commit"} <= set(phases.get(lv["level"], {}))
+            for lv in levels
+        )
+        if measured:
+            cursor = seg_ts
+            for lv in levels:
+                ph = phases[lv["level"]]
+                args = {k: lv[k] for k in
+                        ("level", "generated", "distinct", "queue",
+                         "bodies", "expanded") if k in lv}
+                args["measured"] = True
+                for phase in ("expand", "commit"):
+                    dur = max(ph[phase] * 1e6, 1.0)
+                    out.append({
+                        "name": f"{phase} L{lv['level']}", "ph": "X",
+                        "ts": cursor, "dur": dur, "pid": PID_DEVICE,
+                        "tid": TID_EXPAND if phase == "expand"
+                        else TID_COMMIT,
+                        "args": {**args, "wall_s": ph[phase]},
+                    })
+                    cursor += dur
+                out.append({"name": "states", "ph": "C",
+                            "ts": cursor, "pid": PID_DEVICE, "tid": 0,
+                            "args": {"distinct": lv["distinct"],
+                                     "queue": lv["queue"]}})
+                if "fp_load" in lv:
+                    out.append({"name": "fp_load", "ph": "C",
+                                "ts": cursor, "pid": PID_DEVICE,
+                                "tid": 0,
+                                "args": {"load": lv["fp_load"]}})
+            return
         bodies = [max(lv.get("bodies_level", 1), 1) for lv in levels]
         total = float(sum(bodies))
         cursor = seg_ts
@@ -158,6 +199,19 @@ def chrome_trace_events(events: List[dict]) -> List[dict]:
             )
             prev_level = ev
             pending_levels.append(lv)
+        elif kind == "phase":
+            if ev["scope"] == "level":
+                pending_phases.setdefault(ev["index"], {})[
+                    ev["phase"]
+                ] = ev["wall_s"]
+            elif ev["scope"] == "segment" and ev["phase"] == "readback":
+                out.append({
+                    "name": "readback", "ph": "X",
+                    "ts": us(ev["t"] - ev["wall_s"]),
+                    "dur": max(ev["wall_s"] * 1e6, 1.0),
+                    "pid": PID_HOST, "tid": TID_CKPT,
+                    "args": {"segment": ev["index"]},
+                })
         elif kind == "checkpoint":
             out.append({
                 "name": f"checkpoint ({ev['label']})", "ph": "X",
@@ -240,8 +294,20 @@ def _tiny_journal(path: str) -> None:
             td = base + 0.1 * s
             j.event("segment", index=s, t_dispatch=td,
                     t_fence=td + 0.09, wall_s=0.09)
+            j.event("phase", scope="segment", index=s, phase="device",
+                    wall_s=0.09)
+            j.event("phase", scope="segment", index=s, phase="readback",
+                    wall_s=0.002)
             for i in range(2):
                 lvl = 2 * s + i + 1
+                # second segment: measured per-level walls (the
+                # -phase-timing tier) so the exporter's measured-lane
+                # path is exercised alongside the schematic one
+                if s == 1:
+                    j.event("phase", scope="level", index=lvl,
+                            phase="expand", wall_s=0.03, bodies=2)
+                    j.event("phase", scope="level", index=lvl,
+                            phase="commit", wall_s=0.012, bodies=2)
                 j.event("level", level=lvl, generated=100 * lvl,
                         distinct=60 * lvl, queue=30, bodies=4 * lvl,
                         expanded=50 * lvl, fp_load=0.01 * lvl)

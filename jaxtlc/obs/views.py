@@ -54,6 +54,90 @@ def eta_s(prev: Optional[dict], cur: dict) -> Optional[float]:
     return cur["queue"] / (dd / dt)
 
 
+def phase_totals(events) -> dict:
+    """Cumulative measured wall seconds per phase name from the `phase`
+    events (obs.phases) of a journal: {phase: seconds}.  Level- and
+    segment-scope rows both accumulate (they attribute different walls:
+    expand/commit device halves vs device/readback fence intervals)."""
+    out = {}
+    for ev in events:
+        if ev.get("event") == "phase":
+            key = ev["phase"]
+            out[key] = out.get(key, 0.0) + float(ev["wall_s"])
+    return out
+
+
+def metrics_from_events(events) -> dict:
+    """The run-monitoring metric set (obs.serve /metrics) as one flat
+    dict, derived from a journal event list by the SAME arithmetic the
+    TLC 2200 line and tlcstat use (interval_rates / eta_s above), so a
+    Prometheus scrape can never disagree with the transcript."""
+    prog = [e for e in events
+            if e["event"] in ("level", "progress", "final",
+                              "interrupted", "exhausted", "recovery")]
+    cur = prog[-1] if prog else None
+    levels = [e for e in events if e["event"] == "level"]
+    prev = levels[-2] if len(levels) > 1 else None
+    counts = {}
+    for e in events:
+        counts[e["event"]] = counts.get(e["event"], 0) + 1
+    out = {
+        "events_total": len(events),
+        "segments_total": counts.get("segment", 0),
+        "checkpoints_total": counts.get("checkpoint", 0),
+        "regrows_total": counts.get("regrow", 0),
+        "retries_total": counts.get("retry", 0),
+        "degrades_total": counts.get("degrade", 0),
+    }
+    manifest = next((e for e in events if e["event"] == "run_start"),
+                    None)
+    fin = next((e for e in reversed(events) if e["event"] == "final"),
+               None)
+    info = {}
+    if manifest is not None:
+        info = {"workload": manifest["workload"],
+                "engine": manifest["engine"],
+                "device": manifest["device"]}
+    info["verdict"] = fin["verdict"] if fin is not None else "running"
+    out["run_info"] = info
+    if cur is not None:
+        out["generated_total"] = cur.get("generated", 0)
+        out["distinct_total"] = cur.get("distinct", 0)
+        out["queue"] = cur.get("queue", 0)
+        out["depth"] = cur.get("level", cur.get("depth", 0))
+        if prev is not None and cur["event"] == "level":
+            spm, dpm = interval_rates(
+                (prev["t"], prev["generated"], prev["distinct"]),
+                cur["t"], cur["generated"], cur["distinct"],
+            )
+            out["states_per_second"] = round(spm / 60.0, 3)
+            out["distinct_per_second"] = round(dpm / 60.0, 3)
+            eta = eta_s(prev, cur)
+            if eta is not None:
+                out["queue_drain_eta_seconds"] = round(eta, 3)
+        if "fp_load" in cur:
+            out["fp_load"] = cur["fp_load"]
+    sp = next((e for e in reversed(events) if e["event"] == "spill"),
+              None)
+    if sp is not None:
+        out["spill_spilled"] = sp["spilled"]
+        out["spill_capacity"] = sp["capacity"]
+        out["spill_occupancy"] = round(
+            sp["spilled"] / max(sp["capacity"], 1), 6
+        )
+        out["spill_hit_rate"] = round(
+            sp.get("hits", 0) / max(sp.get("probes", 0), 1), 6
+        )
+    phases = phase_totals(events)
+    if phases:
+        out["phase_wall_seconds"] = {
+            k: round(v, 6) for k, v in sorted(phases.items())
+        }
+    if fin is not None:
+        out["wall_seconds"] = fin["wall_s"]
+    return out
+
+
 def render_tlc_event(log, ev: dict, resume_cmd: str = "") -> None:
     """Render one journal event as its TLC structured-log banner.
 

@@ -271,6 +271,13 @@ def migrate_shard_carry(
             for f in ("obs_ring", "obs_head", "obs_bodies",
                       "obs_expanded")
         })
+    if getattr(carry, "obs_pl_flag", None) is not None:
+        # pipeline x obs: the deferred level-flip row (level + staged
+        # flag) migrates verbatim - geometry-independent scalars
+        pv.update({
+            f: jnp.asarray(np.asarray(getattr(carry, f)))
+            for f in ("obs_pl_level", "obs_pl_flag")
+        })
     return ShardCarry(
         table=jnp.asarray(table2),
         queue=jnp.asarray(queue2),

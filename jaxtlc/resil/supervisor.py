@@ -184,6 +184,13 @@ class SupervisorOptions:
     # max_regrow is exhausted); "on" prefers it over regrowing at the
     # FIRST fpset saturation; "off" removes the rung from the ladder
     spill: str = "auto"
+    # CLI -phase-timing: swap the fused segment dispatch for the
+    # host-fenced expand/commit step loop (obs.phases.PhasedRuntime) so
+    # every level gets MEASURED phase walls as `phase` journal events.
+    # Bit-for-bit results; costs a fence per step (PERF.md round 11).
+    # Adapters without a phased build (pipelined, sharded) fall back to
+    # the free segment-scope attribution every run gets anyway.
+    phase_timing: bool = False
     # initial host-store capacity (auto-grows in host RAM)
     spill_capacity: int = 1 << 15
     # rung-3 floor: chunk never shrinks below this
@@ -380,6 +387,34 @@ class SingleDeviceAdapter:
             spill_write_hook=spill_write_hook,
         )
 
+    def supports_phase_timing(self) -> bool:
+        # fencing the pipelined body would serialize the overlap it
+        # exists to create; the ladder's segment-scope attribution
+        # still applies there
+        return not self.pipeline
+
+    def build_phased(self, params: dict, ckpt_every: int, recorder):
+        """(template, seg_fn) through obs.phases.PhasedRuntime: the
+        host-fenced expand/commit step loop with measured per-level
+        walls, bit-for-bit the fused segment's carry."""
+        from ..obs.phases import PhasedRuntime
+
+        backend = self.backend
+        check_deadlock = self.check_deadlock
+        if backend is None:
+            from ..engine.backend import kubeapi_backend
+
+            backend = kubeapi_backend(self.cfg)
+            check_deadlock = None  # the kubeapi backend's own default
+        rt = PhasedRuntime(
+            backend, self.chunk, params["queue_capacity"],
+            params["fp_capacity"], fp_index=self.fp_index,
+            seed=self.seed, fp_highwater=self.fp_highwater,
+            check_deadlock=check_deadlock, obs_slots=self.obs_slots,
+            recorder=recorder,
+        )
+        return rt.init_fn(), rt.segment_fn(ckpt_every)
+
     def can_shrink(self, floor: int = MIN_CHUNK) -> bool:
         return not self.pipeline and self.chunk // 2 >= floor
 
@@ -536,7 +571,7 @@ def _emit(opts: SupervisorOptions, kind: str, **info) -> None:
 
 
 def _resume(adapter, params: dict, opts: SupervisorOptions,
-            make_spill_runtime):
+            make_spill_runtime, build=None):
     """Load the newest verifiable checkpoint of the family `ckpt_path`
     (generations first, then the plain file for pre-supervisor
     snapshots), rebuilding the engine with the recorded geometry.  A
@@ -576,7 +611,10 @@ def _resume(adapter, params: dict, opts: SupervisorOptions,
             template = spill_rt.init_fn()
             seg_fn = spill_rt.segment_fn(opts.ckpt_every)
         else:
-            template, seg_fn = adapter.build(new_params, opts.ckpt_every)
+            template, seg_fn = (
+                build(new_params) if build is not None
+                else adapter.build(new_params, opts.ckpt_every)
+            )
         try:
             _, carry = ckpt.load_checkpoint(path, template)
         except ckpt.CheckpointCorruptError as e:
@@ -662,6 +700,24 @@ def supervise(adapter, params: dict,
             spill_write_hook=faults.spill_write,
         )
 
+    # -phase-timing: measured per-level expand/commit walls through the
+    # host-fenced step loop, where the adapter supports it (unpipelined
+    # single-device); every run gets the free segment-scope attribution
+    # below regardless
+    phase_rec = None
+    if (opts.phase_timing
+            and callable(getattr(adapter, "build_phased", None))
+            and getattr(adapter, "supports_phase_timing",
+                        lambda: False)()):
+        from ..obs.phases import PhaseRecorder
+
+        phase_rec = PhaseRecorder()
+
+    def build_engine(p):
+        if phase_rec is not None:
+            return adapter.build_phased(p, opts.ckpt_every, phase_rec)
+        return adapter.build(p, opts.ckpt_every)
+
     def rebuild(p):
         """(template, seg_fn) for geometry `p` in the CURRENT mode: the
         spill runtime is rebuilt around the same host store when the
@@ -673,19 +729,20 @@ def supervise(adapter, params: dict,
             spill_rt.flushes = old.flushes
             spill_rt.probes = old.probes
             return spill_rt.init_fn(), spill_rt.segment_fn(opts.ckpt_every)
-        return adapter.build(p, opts.ckpt_every)
+        return build_engine(p)
 
     if opts.resume:
         if not opts.ckpt_path:
             raise ValueError("resume requires a checkpoint path")
         params, template, seg_fn, carry, path, spill_rt = _resume(
-            adapter, params, opts, make_spill_runtime
+            adapter, params, opts, make_spill_runtime,
+            build=build_engine,
         )
         prog = adapter.progress(carry)
         _emit(opts, "recovery", path=path, depth=prog[0],
               generated=prog[1], distinct=prog[2], queue=prog[3])
     else:
-        template, seg_fn = adapter.build(params, opts.ckpt_every)
+        template, seg_fn = build_engine(params)
         carry = template
     # timer starts after the (AOT) build, matching bfs.check's discipline
     # (regrow rebuilds DO count: recompilation is part of regrow's price)
@@ -791,6 +848,10 @@ def supervise(adapter, params: dict,
             while True:
                 try:
                     faults.segment_start(segments)
+                    if phase_rec is not None:
+                        # a replayed segment re-measures; timings of
+                        # the failed attempt must not double-count
+                        phase_rec.reset()
                     t_dispatch = time.time()
                     in_flight = seg_fn(good)
                     # host work overlapping the running segment: the
@@ -990,6 +1051,7 @@ def supervise(adapter, params: dict,
                   wall_s=round(t_fence - t_dispatch, 6))
             if opts.ckpt_path:
                 pending_save = (good, good_store)
+            t_readback = time.time()
             if adapter.viol(carry) == OK and not adapter.done(carry):
                 d, g, di, q = adapter.progress(carry)
                 _emit(opts, "progress", depth=d, generated=g,
@@ -1000,6 +1062,20 @@ def supervise(adapter, params: dict,
                 rows, obs_seen = obs_read(carry, obs_seen, params)
                 for row in rows:
                     _emit(opts, "level", **row)
+            # phase attribution (obs.phases): the free fence-scope rows
+            # (device wall + the host readback wall just measured) plus
+            # the measured per-level expand/commit walls in -phase-
+            # timing mode - pure host arithmetic over syncs already paid
+            from ..obs.phases import segment_phases
+
+            for row in segment_phases(
+                segments - 1, t_fence - t_dispatch,
+                readback_s=time.time() - t_readback,
+            ):
+                _emit(opts, "phase", **row)
+            if phase_rec is not None:
+                for row in phase_rec.drain():
+                    _emit(opts, "phase", **row)
 
         # the final segment's snapshot has no next segment to hide
         # behind: write it at the fence

@@ -541,8 +541,8 @@ def test_selfcheck_registry_pinned():
     from jaxtlc.analysis.selfcheck import FACTORIES
 
     assert sorted(FACTORIES) == [
-        "enumerator", "fused", "pipelined", "sharded", "spill",
-        "struct",
+        "enumerator", "fused", "phased", "pipelined", "sharded",
+        "spill", "struct",
     ]
 
 

@@ -49,30 +49,59 @@ def clean_ff():
     return check(FF, **KW)
 
 
+def _http_get(url, timeout=10.0):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
 @pytest.fixture(scope="module")
 def obs_run(tmp_path_factory):
     """ONE supervised obs-on FF run journaling to disk: the golden
-    input shared by the schema/ring/trace tests below."""
+    input shared by the schema/ring/trace tests below.  An obs.serve
+    monitor runs over the journal directory for the run's duration,
+    and /metrics + /events + /runs are queried FROM INSIDE the event
+    hook mid-run - the live-serving acceptance criterion with zero
+    extra engine compiles."""
+    from jaxtlc.obs.serve import start_server
+
     d = tmp_path_factory.mktemp("obs")
     path = str(d / "run.journal.jsonl")
-    with jr.RunJournal(path) as j:
-        j.event("run_start", version="test", workload="FF",
-                engine="single", device="cpu",
-                params={**KW, "obs_slots": 64, "pipeline": False})
-        sr = check_supervised(
-            FF, obs_slots=64,
-            opts=SupervisorOptions(
-                ckpt_every=16, on_event=lambda k, i: j.event(k, **i)
-            ),
-            **KW,
-        )
-    return sr, path
+    server = start_server(str(d))
+    live = {}
+    seen = [0]
+
+    def hook(j, kind, info):
+        j.event(kind, **info)
+        seen[0] += 1
+        if seen[0] == 40:  # mid-run: the endpoints must answer NOW
+            live["metrics"] = _http_get(server.url + "/metrics")
+            live["runs"] = _http_get(server.url + "/runs")
+            live["events"] = _http_get(server.url + "/events?once=1")
+
+    try:
+        with jr.RunJournal(path) as j:
+            j.event("run_start", version="test", workload="FF",
+                    engine="single", device="cpu",
+                    params={**KW, "obs_slots": 64, "pipeline": False})
+            sr = check_supervised(
+                FF, obs_slots=64,
+                opts=SupervisorOptions(
+                    ckpt_every=16,
+                    on_event=lambda k, i: hook(j, k, i),
+                ),
+                **KW,
+            )
+    finally:
+        server.shutdown()
+    return sr, path, live
 
 
 def test_journal_schema_golden(obs_run):
     """Every line of a real run's journal validates against the
     versioned schema; the run ends with exactly one final event."""
-    sr, path = obs_run
+    sr, path, _ = obs_run
     events = jr.read(path)  # validate=True: schema-checks every line
     assert events, "journal must not be empty"
     for ev in events:
@@ -81,16 +110,46 @@ def test_journal_schema_golden(obs_run):
     kinds = [e["event"] for e in events]
     assert kinds[0] == "run_start"
     assert kinds.count("final") == 1 and kinds[-1] == "final"
+    # the fence-mode phase tier: device + readback walls per segment,
+    # free at the syncs the supervisor already pays
+    seg_phases = [e for e in events if e["event"] == "phase"]
+    assert seg_phases and all(e["scope"] == "segment"
+                              for e in seg_phases)
+    assert {e["phase"] for e in seg_phases} == {"device", "readback"}
+    n_segments = kinds.count("segment")
+    assert len(seg_phases) == 2 * n_segments
     fin = events[-1]
     assert fin["verdict"] == "ok" and not fin["interrupted"]
     assert (fin["generated"], fin["distinct"], fin["depth"]) == EXPECT_FF
     assert fin["wall_s"] > 0
 
 
+def test_serve_endpoints_answer_during_live_run(obs_run):
+    """ISSUE 8 acceptance: /metrics, /events and /runs answered WHILE
+    the supervised run was mid-flight (queried from inside the event
+    hook at event 40 - the run was nowhere near done)."""
+    _, path, live = obs_run
+    assert set(live) == {"metrics", "runs", "events"}
+    m = live["metrics"]
+    for needle in ("jaxtlc_run_info", 'workload="FF"',
+                   'verdict="running"', "jaxtlc_generated_total",
+                   "jaxtlc_distinct_total",
+                   "jaxtlc_phase_wall_seconds{phase="):
+        assert needle in m, (needle, m)
+    import json as _json
+
+    runs = _json.loads(live["runs"])["runs"]
+    assert len(runs) == 1 and runs[0]["verdict"] == "running"
+    datas = [ln for ln in live["events"].splitlines()
+             if ln.startswith("data: ")]
+    assert len(datas) >= 40  # the SSE snapshot saw the live history
+    assert '"event": "run_start"' in datas[0]
+
+
 def test_obs_bit_identical_and_ring(obs_run, clean_ff):
     """Acceptance: obs-on results == obs-off engine bit-for-bit, and
     the ring's per-level rows are exact cumulative telemetry."""
-    sr, path = obs_run
+    sr, path, _ = obs_run
     assert signature(sr.result) == signature(clean_ff)
     levels = [e for e in jr.read(path) if e["event"] == "level"]
     assert len(levels) == EXPECT_FF[2]  # one row per BFS level
@@ -109,6 +168,44 @@ def test_obs_bit_identical_and_ring(obs_run, clean_ff):
         assert b["bodies"] > a["bodies"]
 
 
+def test_phase_timing_bit_identical_measured_lanes(clean_ff, tmp_path):
+    """ISSUE 8 tentpole: a -phase-timing run (host-fenced expand/commit
+    halves jitted from the SAME stage closures the fused body composes)
+    is bit-for-bit the fused engine, journals measured per-level
+    `phase` events covering every BFS level, and the trace exporter
+    renders those walls as measured lanes instead of the schematic."""
+    path = str(tmp_path / "phased.journal.jsonl")
+    with jr.RunJournal(path) as j:
+        sr = check_supervised(
+            FF, obs_slots=64,
+            opts=SupervisorOptions(
+                ckpt_every=32, phase_timing=True,
+                on_event=lambda k, i: j.event(k, **i),
+            ),
+            **KW,
+        )
+    assert signature(sr.result) == signature(clean_ff)
+    events = jr.read(path)  # schema-validates every line
+    lv = [e for e in events
+          if e["event"] == "phase" and e["scope"] == "level"]
+    assert {e["index"] for e in lv} == set(range(1, EXPECT_FF[2] + 1))
+    for phase in ("expand", "commit"):
+        walls = [e["wall_s"] for e in lv if e["phase"] == phase]
+        assert len(walls) >= EXPECT_FF[2] and sum(walls) > 0
+    # bodies across the expand rows = total engine bodies (each step
+    # measured exactly once)
+    bodies = sum(e["bodies"] for e in lv if e["phase"] == "expand")
+    levels = [e for e in events if e["event"] == "level"]
+    assert bodies == levels[-1]["bodies"]
+    out = str(tmp_path / "phased.trace.json")
+    export_chrome_trace(events, out)
+    doc = json.load(open(out))
+    lanes = [e for e in doc["traceEvents"]
+             if e.get("args", {}).get("measured")]
+    assert len(lanes) == 2 * EXPECT_FF[2]  # expand + commit per level
+    assert all(e["dur"] >= 1.0 for e in lanes)
+
+
 def test_obs_ring_survives_regrow(clean_ff):
     """Undersized run: auto-regrow migrates the ring verbatim, the
     final statistics still match the clean run exactly and the ring's
@@ -124,7 +221,7 @@ def test_obs_ring_survives_regrow(clean_ff):
 def test_trace_export_from_golden_journal(obs_run, tmp_path):
     """The journal renders to a Perfetto-loadable Chrome trace with the
     expand/commit lanes and counter tracks present."""
-    _, path = obs_run
+    _, path, _ = obs_run
     out = str(tmp_path / "run.trace.json")
     n = export_chrome_trace(jr.read(path), out)
     doc = json.load(open(out))
@@ -198,11 +295,59 @@ def test_cli_sigterm_recover_one_continuous_journal(tmp_path, capsys):
     assert rc == 75  # EXIT_INTERRUPTED
     jpath = ck + ".journal.jsonl"
     assert os.path.exists(jpath)  # journals beside the checkpoint
-    rc = main(["check", str(d / "MC.cfg"), *flags, "-recover",
-               "-trace-out", trace])
-    assert rc == 0
+    # ISSUE 8 satellite: an SSE subscriber attached across the
+    # interrupt->-recover boundary sees ONE continuous event stream
+    # (the resumed run APPENDS to the same journal the tail follows)
+    import threading
+
+    from jaxtlc.obs.serve import start_server
+
+    server = start_server(str(d))
+    sse_lines = []
+
+    def subscribe():
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(server.url + "/events",
+                                        timeout=60) as r:
+                while True:
+                    line = r.readline()
+                    if not line:
+                        return
+                    if line.startswith(b"data: "):
+                        sse_lines.append(line[6:].decode())
+        except OSError:
+            pass
+
+    sub = threading.Thread(target=subscribe, daemon=True)
+    sub.start()
+    try:
+        rc = main(["check", str(d / "MC.cfg"), *flags, "-recover",
+                   "-trace-out", trace])
+        assert rc == 0
+        # the run is over and the journal closed: wait for the tail to
+        # drain the remaining appended events
+        want = len(jr.read(jpath, validate=False))
+        deadline = _time.time() + 10
+        while _time.time() < deadline and len(sse_lines) < want:
+            _time.sleep(0.1)
+    finally:
+        server.shutdown()
+    sub.join(timeout=10)
     capsys.readouterr()
     events = jr.read(jpath)  # every line of BOTH attempts validates
+    # the subscriber's stream IS the journal: every event exactly once,
+    # in order, spanning SIGTERM -> 75 -> -recover -> verdict
+    stream = [json.loads(s) for s in sse_lines]
+    assert [e["event"] for e in stream] == [e["event"] for e in events]
+    skinds = [e["event"] for e in stream]
+    for needle in ("run_start", "interrupted", "run_resume", "final"):
+        assert needle in skinds
+    assert skinds.index("interrupted") < skinds.index("run_resume")
+    finals_stream = [e for e in stream if e["event"] == "final"]
+    assert [f["verdict"] for f in finals_stream] == ["interrupted",
+                                                    "ok"]
     kinds = [e["event"] for e in events]
     assert kinds[0] == "run_start"
     for needle in ("interrupted", "run_resume", "recovery", "level"):

@@ -1,0 +1,164 @@
+"""Run-monitoring server tests (ISSUE 8): the live ops plane's serving
+surface, exercised entirely on synthetic journals - no engine, no jax
+compiles (tier-1 runs at ~800 s of its 870 s budget).
+
+- SSE tail semantics: events stream exactly once, in order; a TORN
+  trailing line (the fsync-append crash window) is held back until the
+  writer completes it - never emitted partial, never emitted twice;
+- the run registry multiplexes several journals through one server,
+  with ?run= selection on every endpoint;
+- `python -m jaxtlc.obs.serve --tiny` smokes the whole pipeline;
+- tools/tlcstat.py --connect renders its dashboard from a remote
+  monitor (a client of the same views).
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from jaxtlc.obs import journal as jr
+from jaxtlc.obs import serve as obs_serve
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _progress(j, depth):
+    return j.event("progress", depth=depth, generated=10 * depth,
+                   distinct=5 * depth, queue=depth)
+
+
+def test_sse_tail_survives_torn_trailing_line(tmp_path):
+    """The mid-tail crash window: a partially-appended final line must
+    be invisible to the SSE subscriber until the writer completes it,
+    and then arrive exactly once."""
+    path = str(tmp_path / "run.journal.jsonl")
+    with jr.RunJournal(path) as j:
+        _progress(j, 1)
+        _progress(j, 2)
+    srv = obs_serve.start_server(str(tmp_path))
+    got = []
+
+    def subscribe():
+        try:
+            with urllib.request.urlopen(srv.url + "/events",
+                                        timeout=30) as r:
+                while True:
+                    line = r.readline()
+                    if not line:
+                        return
+                    if line.startswith(b"data: "):
+                        got.append(json.loads(line[6:].decode()))
+        except OSError:
+            pass
+
+    sub = threading.Thread(target=subscribe, daemon=True)
+    sub.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and len(got) < 2:
+            time.sleep(0.05)
+        assert [e["depth"] for e in got] == [1, 2]
+
+        # tear a line mid-append: the subscriber must NOT see it
+        def line(depth):
+            return json.dumps(
+                {"v": 1, "t": float(depth), "event": "progress",
+                 "depth": depth, "generated": 10 * depth,
+                 "distinct": 5 * depth, "queue": depth},
+                sort_keys=True)
+
+        whole = line(3)
+        with open(path, "a") as f:
+            f.write(whole[:25])
+            f.flush()
+        time.sleep(4 * obs_serve.POLL_S)
+        assert len(got) == 2  # partial line held back
+
+        # the writer completes the line (and appends another): both
+        # arrive, exactly once, in order
+        with open(path, "a") as f:
+            f.write(whole[25:] + "\n")
+            f.write(line(4) + "\n")
+        deadline = time.time() + 10
+        while time.time() < deadline and len(got) < 4:
+            time.sleep(0.05)
+    finally:
+        srv.shutdown()
+    sub.join(timeout=10)
+    assert [e["depth"] for e in got] == [1, 2, 3, 4]
+
+
+def test_runs_registry_multiplexes(tmp_path):
+    """Two concurrent journals, one server: /runs lists both, ?run=
+    selects each on /metrics and /journal."""
+    for name, depth, done in (("alpha", 3, True), ("beta", 7, False)):
+        with jr.RunJournal(str(tmp_path / f"{name}.journal.jsonl")) as j:
+            j.event("run_start", version="t", workload=name.upper(),
+                    engine="single", device="cpu", params={})
+            _progress(j, depth)
+            if done:
+                j.event("final", verdict="ok", generated=30,
+                        distinct=15, depth=depth, queue=0, wall_s=0.1,
+                        interrupted=False)
+    srv = obs_serve.start_server(str(tmp_path))
+    try:
+        runs = json.loads(_get(srv.url + "/runs"))["runs"]
+        assert {r["run"] for r in runs} == {"alpha", "beta"}
+        by_name = {r["run"]: r for r in runs}
+        assert by_name["alpha"]["verdict"] == "ok"
+        assert by_name["beta"]["verdict"] == "running"
+        assert by_name["beta"]["workload"] == "BETA"
+        m_a = _get(srv.url + "/metrics?run=alpha")
+        assert 'workload="ALPHA"' in m_a and 'verdict="ok"' in m_a
+        m_b = _get(srv.url + "/metrics?run=beta")
+        assert 'verdict="running"' in m_b
+        assert "jaxtlc_depth 7" in m_b
+        raw = _get(srv.url + "/journal?run=beta")
+        assert len(raw.splitlines()) == 2
+        # an unknown run is a clean 404, not a traceback
+        try:
+            _get(srv.url + "/metrics?run=nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_serve_tiny_smoke(capsys):
+    """`python -m jaxtlc.obs.serve --tiny`: synthesize, serve, query
+    every endpoint, assert - the tier-1 wiring of the server."""
+    assert obs_serve.main(["--tiny"]) == 0
+    out = capsys.readouterr().out
+    assert "serve tiny OK" in out
+
+
+def test_tlcstat_connect_renders_remote_run(tmp_path, capsys):
+    """tlcstat --connect URL: the same dashboard, rendered from a
+    remote monitor's /journal endpoint."""
+    from jaxtlc.obs.trace import _tiny_journal
+
+    _tiny_journal(str(tmp_path / "tiny.journal.jsonl"))
+    srv = obs_serve.start_server(str(tmp_path))
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "tlcstat",
+            os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                         "tlcstat.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main(["--connect", srv.url, "--run", "tiny"]) == 0
+        out = capsys.readouterr().out
+        for needle in ("ds/min", "VERDICT: interrupted",
+                       "phase walls:", "spill tier:"):
+            assert needle in out, (needle, out)
+    finally:
+        srv.shutdown()

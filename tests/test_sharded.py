@@ -48,11 +48,36 @@ def test_sharded_pipelined_bit_identical_2dev():
     all_to_all deferred behind the next routing collective) is
     bit-for-bit the unpipelined mesh engine on 2 devices - full
     signature, not just counts (the deferred adds are the same uint32
-    adds, one body later)."""
+    adds, one body later).
+
+    ISSUE 8 satellite (the PR 5 documented caveat, fixed): with the
+    counter ring on, the pipelined engine's PER-LEVEL rows now equal
+    the fused engine's exactly - the flip row is written one body late,
+    after the deferred verdict fold completes act_dist, so per-level
+    action-distinct attribution lands on the correct level instead of
+    lagging one chunk."""
+    from jaxtlc.engine.backend import kubeapi_backend
+    from jaxtlc.engine.sharded import (
+        make_sharded_engine,
+        obs_rows_sharded,
+        result_from_shard_carry,
+    )
+
     kw = dict(chunk=128, queue_capacity=1 << 11, fp_capacity=1 << 14)
     mesh = _mesh(2)
-    a = check_sharded(FF, mesh, **kw)
-    b = check_sharded(FF, mesh, pipeline=True, **kw)
+    labels = kubeapi_backend(FF).labels
+    fp_total = 2 * kw["fp_capacity"]
+    outs = {}
+    for pipe in (False, True):
+        init_fn, run_fn = make_sharded_engine(
+            FF, mesh, obs_slots=128, pipeline=pipe,
+            backend=kubeapi_backend(FF), **kw,
+        )
+        outs[pipe] = jax.block_until_ready(run_fn(init_fn()))
+    a = result_from_shard_carry(outs[False], 0.0, labels=labels,
+                                fp_capacity_total=fp_total)
+    b = result_from_shard_carry(outs[True], 0.0, labels=labels,
+                                fp_capacity_total=fp_total)
     assert (a.generated, a.distinct, a.depth) == EXPECT
     assert (
         (a.generated, a.distinct, a.depth, a.violation, a.queue_left,
@@ -65,6 +90,19 @@ def test_sharded_pipelined_bit_identical_2dev():
          tuple(sorted(b.action_distinct.items())), b.outdegree,
          b.fp_occupancy)
     )
+    # per-level ring rows: one per BFS level on both engines, and every
+    # per-level counter - action_distinct above all - attributes to the
+    # SAME level (the regression the deferred-row scheme fixes)
+    rows_a, _ = obs_rows_sharded(outs[False], labels=labels,
+                                 fp_capacity_total=fp_total)
+    rows_b, _ = obs_rows_sharded(outs[True], labels=labels,
+                                 fp_capacity_total=fp_total)
+    assert len(rows_a) == len(rows_b) == EXPECT[2]
+    for x, y in zip(rows_a, rows_b):
+        for key in ("level", "generated", "distinct", "queue",
+                    "bodies", "expanded", "action_generated",
+                    "action_distinct"):
+            assert x.get(key) == y.get(key), (x["level"], key)
 
 
 @pytest.mark.slow

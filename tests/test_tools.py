@@ -69,6 +69,48 @@ def test_tlcstat_tiny_smoke(capsys):
         assert needle in out, f"tlcstat output lost {needle!r}:\n{out}"
 
 
+def test_costmodel_tiny_smoke(capsys):
+    """costmodel --tiny: the sweep -> fit -> COSTMODEL.json -> PERF
+    table pipeline on the synthetic measurer, whose walls are exactly
+    linear - so the smoke asserts the fitter RECOVERS the planted
+    coefficients (no engine compiles: tier-1 budget; the committed
+    COSTMODEL.json exercises the real measurement path)."""
+    mod = _load_tool("costmodel")
+    assert mod.main(["--tiny"]) == 0
+    out = capsys.readouterr().out
+    for needle in ("| chunk |", "costmodel tiny OK"):
+        assert needle in out, f"costmodel output lost {needle!r}:\n{out}"
+
+
+def test_committed_costmodel_document():
+    """The committed COSTMODEL.json (the measured baseline ROADMAP #1's
+    MXU commit rewrite is judged against) satisfies the document
+    contract: every phase measured at every chunk, fits present, and
+    the commit-phase breakdown (sort vs probe vs enqueue) non-trivial."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "COSTMODEL.json")
+    assert os.path.exists(path), "COSTMODEL.json must be committed"
+    with open(path) as f:
+        doc = json.load(f)
+    mod = _load_tool("costmodel")
+    assert doc["version"] == mod.COSTMODEL_VERSION
+    assert doc["workload"] == "Model_1"
+    chunks = {str(c) for c in doc["chunks"]}
+    for p in mod.PHASES:
+        assert set(doc["ms_per_step"][p]) == chunks, p
+        assert "a_ms" in doc["fit"][p]
+    # the fitted commit breakdown: sort + probe + enqueue account for
+    # the commit half at the largest chunk (within measurement slop)
+    big = str(max(doc["chunks"]))
+    parts = sum(doc["ms_per_step"][p][big]
+                for p in ("sort", "probe", "enqueue"))
+    assert parts > 0
+    assert doc["ms_per_step"]["commit"][big] > 0
+    assert doc["phase_event_ms_per_step"]["commit"][big] > 0
+    # and the table renderer accepts the committed document
+    assert "| chunk |" in mod.perf_table(doc)
+
+
 def test_trace_exporter_tiny_smoke(capsys):
     """The Chrome-trace exporter's --tiny: synthesize a journal, export
     it, and assert the expand/commit lanes landed in the JSON."""

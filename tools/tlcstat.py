@@ -11,16 +11,21 @@ counts, and the last journal event.
 
     python tools/tlcstat.py RUN.journal.jsonl            # one frame
     python tools/tlcstat.py RUN.journal.jsonl --follow   # live tail
+    python tools/tlcstat.py --connect http://HOST:PORT   # remote run
     python tools/tlcstat.py --tiny                       # tier-1 smoke
 
 The dashboard is a pure view of the journal - it opens the file
 read-only and never blocks the writer (per-event fsync appends are
 atomic at line granularity; a torn trailing line is skipped).
+--connect renders the SAME view over a jaxtlc.obs.serve monitor's
+/journal endpoint (stdlib urllib), so remote runs get the identical
+dashboard; --run NAME selects among the server's registered runs.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -31,7 +36,7 @@ sys.path.insert(
 
 from jaxtlc.obs import journal as jr  # noqa: E402
 from jaxtlc.obs.schema import SCHEMA_VERSION  # noqa: E402
-from jaxtlc.obs.views import eta_s, interval_rates  # noqa: E402
+from jaxtlc.obs.views import eta_s, interval_rates, phase_totals  # noqa: E402
 
 
 def _fmt_eta(s) -> str:
@@ -128,6 +133,14 @@ def render(events) -> str:
             f"{sp.get('hits', 0) / probes:.1%} of {sp.get('probes', 0):,}"
             " probes"
         )
+    # phase attribution (obs.phases): cumulative measured walls per
+    # phase - expand/commit from -phase-timing, device/readback free
+    # at every fence
+    phases = phase_totals(events)
+    if phases:
+        lines.append("phase walls: " + "  ".join(
+            f"{k} {v:.3f}s" for k, v in sorted(phases.items())
+        ))
     last = events[-1]
     age = time.time() - last["t"]
     lines.append(f"last event: {last['event']} ({age:.1f}s ago)")
@@ -144,9 +157,30 @@ def render(events) -> str:
     return "\n".join([bar, *lines, bar])
 
 
+def _fetch_remote(url: str, run: str = "") -> list:
+    """Journal events from a jaxtlc.obs.serve monitor's /journal
+    endpoint (the remote-client mode of the same dashboard)."""
+    import urllib.request
+
+    endpoint = url.rstrip("/") + "/journal"
+    if run:
+        import urllib.parse
+
+        endpoint += "?run=" + urllib.parse.quote(run)
+    with urllib.request.urlopen(endpoint, timeout=10) as r:
+        return [json.loads(line) for line in
+                r.read().decode().splitlines() if line.strip()]
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tlcstat")
     p.add_argument("journal", nargs="?", help="run journal (JSONL)")
+    p.add_argument("--connect", default="", metavar="URL",
+                   help="render a REMOTE run from a jaxtlc.obs.serve "
+                        "monitor (base URL, e.g. http://host:8790)")
+    p.add_argument("--run", default="",
+                   help="with --connect: which registered run "
+                        "(default: the monitor's most recent)")
     p.add_argument("--follow", action="store_true",
                    help="re-render as the journal grows (ctrl-c exits)")
     p.add_argument("--interval", type=float, default=2.0,
@@ -166,10 +200,27 @@ def main(argv=None) -> int:
             _tiny_journal(path)
             frame = render(jr.read(path))
         assert "VERDICT: interrupted" in frame and "ds/min" in frame
+        assert "phase walls:" in frame and "expand" in frame
         print(frame)
         print("tlcstat tiny OK")
         return 0
 
+    if args.connect:
+        try:
+            if not args.follow:
+                print(render(_fetch_remote(args.connect, args.run)))
+                return 0
+            while True:
+                frame = render(_fetch_remote(args.connect, args.run))
+                sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+                sys.stdout.flush()
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        except OSError as e:
+            print(f"tlcstat: cannot reach {args.connect!r}: {e}",
+                  file=sys.stderr)
+            return 1
     if not args.journal:
         p.error("journal path required (or --tiny)")
     if not os.path.exists(args.journal):
