@@ -122,6 +122,65 @@ def _build_spill():
                 fp_capacity=_TINY["fp_capacity"])
 
 
+_SWEEP_SPEC = """---- MODULE SweepAudit ----
+EXTENDS Naturals
+CONSTANTS MAX
+VARIABLES x
+
+Init == x = 0
+
+Up == /\\ x < MAX
+      /\\ x' = x + 1
+
+Next == Up
+
+Spec == Init /\\ [][Next]_x
+
+InRange == x <= MAX
+====
+"""
+
+_SWEEP_CFG = """CONSTANT MAX = 3
+SPECIFICATION
+Spec
+INVARIANT
+InRange
+"""
+
+
+def _build_sweep():
+    # the constants-class sweep engine (jaxtlc.serve.sweep): audited
+    # over a synthetic one-constant module so the registry never
+    # depends on serve-side fixtures; init_fn presents the stacked
+    # width-2 batch carry the vmapped run_fn consumes
+    import os
+    import tempfile
+
+    from ..serve.sweep import SweepEngine, load_anchored
+
+    d = tempfile.mkdtemp(prefix="jaxtlc-sweep-audit-")
+    with open(os.path.join(d, "SweepAudit.tla"), "w") as f:
+        f.write(_SWEEP_SPEC)
+    cfg = os.path.join(d, "SweepAudit.cfg")
+    with open(cfg, "w") as f:
+        f.write(_SWEEP_CFG)
+    params = {"MAX": (1, 3)}
+    model = load_anchored(cfg, params)
+    eng = SweepEngine(
+        model, params, chunk=_TINY["chunk"],
+        queue_capacity=_TINY["queue_capacity"],
+        fp_capacity=_TINY["fp_capacity"], check_deadlock=False,
+        width=2,
+    )
+
+    def init_fn():
+        return eng._stack([{"MAX": 1}, {"MAX": 3}])
+
+    return dict(init_fn=init_fn, run_fn=eng._vrun,
+                n_lanes=eng.backend.n_lanes,
+                fp_capacity=_TINY["fp_capacity"])
+
+
 def _build_phased():
     # the -phase-timing engine wrapper (obs.phases.PhasedRuntime): the
     # DEVICE composition (separately-jitted expand + commit halves) is
@@ -148,6 +207,7 @@ FACTORIES: Dict[str, Callable[[], dict]] = {
     "sharded": _build_sharded,
     "spill": _build_spill,
     "struct": _build_struct,
+    "sweep": _build_sweep,
     "enumerator": _build_enumerator,
 }
 

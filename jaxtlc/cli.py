@@ -36,1063 +36,23 @@ one (auto-grown geometry and the host tier travel with the checkpoint).
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import os
 import sys
-import time
 
-from . import __version__
-from .config import ModelConfig
-from .engine.fingerprint import DEFAULT_SEED
-from .frontend.model import RunSpec, resolve
-from .io.tlc_log import TLCLog
-
-
-def _run_check(args) -> int:
-    try:
-        spec: RunSpec = resolve(
-            args.config,
-            workers=args.workers,
-            fp_index=args.fp,
-            check_deadlock=not args.nodeadlock,
-            frontend=args.frontend,
-        )
-    except (ValueError, OSError) as e:
-        print(f"Error: {e}", file=sys.stderr)
-        return 1
-    from .frontend.model import GenRunSpec, StructRunSpec
-
-    if isinstance(spec, GenRunSpec):
-        return _run_check_gen(args, spec)
-    if isinstance(spec, StructRunSpec):
-        return _run_check_struct(args, spec)
-    from .frontend.model import KNOWN_PROPERTIES
-
-    unknown = [q for q in spec.properties if q not in KNOWN_PROPERTIES]
-    if unknown:
-        print(
-            f"Error: unknown PROPERTY {', '.join(unknown)} "
-            f"(supported: {', '.join(KNOWN_PROPERTIES)})",
-            file=sys.stderr,
-        )
-        return 1
-    if args.mutation:
-        spec.model = dataclasses.replace(spec.model, mutation=args.mutation)
-    if args.recover and not args.checkpoint:
-        print("Error: -recover requires -checkpoint PATH", file=sys.stderr)
-        return 1
-
-    log = TLCLog(tool_mode=not args.noTool,
-                 **_render_sources(args.config, spec.spec_name))
-    import jax
-
-    device = str(jax.devices()[0])
-    log.version(__version__)
-    log.banner(spec.fp_index, DEFAULT_SEED, spec.workers, device)
-    log.sany(*_sany_inputs(args.config, spec.spec_name))
-    log.starting()
-    log.computing_init()
-
-    _open_journal(
-        args, workload=spec.spec_name,
-        engine=("hybrid" if args.fpset == "DiskFPSet"
-                else "sharded" if args.sharded else "single"),
-        device=device,
-        params=dict(chunk=args.chunk, queue_capacity=args.qcap,
-                    fp_capacity=args.fpcap, sharded=args.sharded,
-                    pipeline=args.pipeline,
-                    obs_slots=_obs_slots(args)),
-    )
-
-    def _kubeapi_preflight(deep):
-        from .analysis.preflight import preflight_kubeapi
-
-        return preflight_kubeapi(
-            spec.model, fp_capacity=args.fpcap, chunk=args.chunk,
-            queue_capacity=args.qcap, deep=deep,
-        )
-
-    rc = _preflight_gate(args, log, _kubeapi_preflight)
-    if rc is not None:
-        return rc
-    t0 = time.time()
-    from .resil import SlotOverflowError
-
-    sup = None  # SupervisedResult when the resil supervisor ran
-    try:
-        with _xprof(args):
-            r, sup = _dispatch_check(args, spec, log)
-    except SlotOverflowError as e:
-        log.msg(1000, f"Run stopped: {e}", severity=1)
-        _finish_journal(args, log)
-        return 1
-    except FileNotFoundError as e:
-        print(f"Error: {e}", file=sys.stderr)
-        _finish_journal(args, log)
-        return 1
-    log.init_done(2 ** spec.model.n_reconcilers)
-
-    if sup is not None and sup.interrupted:
-        # the interrupted banner (with the resume command) was already
-        # emitted by the supervisor's event hook
-        from .resil import EXIT_INTERRUPTED
-
-        log.progress(r.depth, r.generated, r.distinct, r.queue_left)
-        log.final_counts(r.generated, r.distinct, r.queue_left)
-        _finish_journal(args, log, r=None, sup=sup)
-        return EXIT_INTERRUPTED
-
-    from .engine.bfs import (
-        VIOL_ASSERT,
-        VIOL_DEADLOCK,
-        VIOL_ONLYONEVERSION,
-        VIOL_TYPEOK,
-    )
-
-    violated = r.violation != 0
-    liveness_violated = False
-    if not violated and (args.liveness or spec.properties):
-        from .live.check import check_properties_device, use_device_path
-        from .spec.codec import get_codec
-        from .spec.pretty import state_to_tla
-
-        props = spec.properties or ["ReconcileCompletes", "CleansUpProperly"]
-        device_path = use_device_path(
-            r.distinct, args.fairness, args.liveness_host
-        )
-        log.checking_temporal(
-            r.distinct, "device" if device_path else "host"
-        )
-        if device_path:
-            mesh = None
-            if args.sharded:
-                from jax.sharding import Mesh
-
-                import numpy as np
-
-                mesh = Mesh(np.array(jax.devices()[: args.sharded]),
-                            ("fp",))
-            results = check_properties_device(
-                spec.model, props, chunk=args.chunk,
-                state_capacity=args.fpcap, fp_capacity=args.fpcap,
-                mesh=mesh,
-                spill_path=args.checkpoint or None,
-            )
-        else:
-            from .engine.liveness import build_graph, check_properties
-
-            graph = build_graph(spec.model, chunk=args.chunk)
-            results = check_properties(
-                spec.model, props, graph=graph,
-                fairness=args.fairness,
-            )
-        decode = get_codec(spec.model).decode
-        for res in results:
-            if res.holds:
-                log.msg(1000, f"Temporal property {res.name} holds "
-                              f"(fairness: {args.fairness}).")
-                continue
-            liveness_violated = True
-            log.msg(2116, f"Temporal properties were violated: {res.name} "
-                          f"(fairness: {args.fairness})", severity=1)
-            idx = 1
-            for enc, act in zip(res.prefix, res.prefix_actions):
-                log.trace_state(idx, act, state_to_tla(decode(enc), spec.model))
-                idx += 1
-            log.msg(1000, "-- The following states form a cycle "
-                          "(back to the first of them) --")
-            for enc, act in zip(res.cycle, res.cycle_actions):
-                log.trace_state(idx, act, state_to_tla(decode(enc), spec.model))
-                idx += 1
-    if violated:
-        if r.violation == VIOL_TYPEOK and "TypeOK" in spec.invariants:
-            log.invariant_violated("TypeOK")
-        elif r.violation == VIOL_ONLYONEVERSION and (
-            "OnlyOneVersion" in spec.invariants
-        ):
-            log.invariant_violated("OnlyOneVersion")
-        elif r.violation == VIOL_ASSERT:
-            log.assertion_failed("Failure of PlusCal assertion.")
-        elif r.violation == VIOL_DEADLOCK and spec.check_deadlock:
-            log.deadlock()
-        else:
-            log.msg(1000, f"Run stopped: {r.violation_name}", severity=1)
-        _print_trace(log, spec.model, args.chunk,
-                     trace_expr_file=args.traceExpressions,
-                     check_deadlock=spec.check_deadlock)
-    elif not liveness_violated:
-        log.success(r.generated, r.distinct,
-                    getattr(r, "actual_fp_collision", None),
-                    occupancy=getattr(r, "fp_occupancy", None))
-        if args.coverage:
-            # full per-expression dump (MC.out:44-1092): re-walk the space
-            # with the instrumented evaluator (host-side; slow for large
-            # configs - TLC's coverage mode pays a similar tax)
-            from .spec.coverage import render_coverage, run_coverage
-
-            cov = run_coverage(spec.model)
-            stamp = time.strftime("%Y-%m-%d %H:%M:%S")
-            for line in render_coverage(cov, stamp, tool_mode=log.tool):
-                log.raw(line)
-        else:
-            log.coverage(2, r.action_generated, r.action_distinct)
-
-    log.progress(r.depth, r.generated, r.distinct, r.queue_left)
-    log.final_counts(r.generated, r.distinct, r.queue_left)
-    log.depth(r.depth)
-    if r.outdegree is not None:
-        log.outdegree(*r.outdegree)
-    log.finished(int((time.time() - t0) * 1000))
-    _finish_journal(
-        args, log, r=r, sup=sup,
-        verdict="liveness_violation" if liveness_violated else None,
-        wall_s=time.time() - t0,
-    )
-    if violated:
-        return 12
-    return 13 if liveness_violated else 0  # TLC liveness exit convention
-
-
-def _xprof(args):
-    """jax.profiler trace context for `-xprof DIR` (the ground-truth
-    device timeline; the journal's -trace-out is the cheap host view).
-    A no-op context when the flag is off."""
-    import contextlib
-
-    if not args.xprof:
-        return contextlib.nullcontext()
-    import jax
-
-    return jax.profiler.trace(args.xprof)
-
-
-def _dispatch_check(args, spec, log):
-    """Run the KubeAPI-path engine picked by the flags.  Returns
-    (CheckResult, SupervisedResult-or-None).
-
-    Dispatch priority: DiskFPSet routes to the host tier even when
-    -sharded is given (sharding then means fingerprint-space partitions).
-    The resil supervisor wraps the device engines whenever -auto-grow
-    (default) or -checkpoint is in play; -no-auto-grow without
-    -checkpoint keeps the raw fused single-dispatch path."""
-    import jax
-
-    if args.sharded and args.fpset != "DiskFPSet":
-        import numpy as np
-        from jax.sharding import Mesh
-
-        from .engine.sharded import check_sharded
-
-        mesh = Mesh(np.array(jax.devices()[: args.sharded]), ("fp",))
-        if args.checkpoint or args.autogrow:
-            from .resil import check_sharded_supervised
-
-            sup = check_sharded_supervised(
-                spec.model,
-                mesh,
-                chunk=args.chunk,
-                queue_capacity=args.qcap,
-                fp_capacity=args.fpcap,
-                route_factor=args.routefactor,
-                pipeline=args.pipeline,
-                obs_slots=_obs_slots(args),
-                opts=_sup_opts(args, log),
-            )
-            return sup.result, sup
-        return check_sharded(
-            spec.model,
-            mesh,
-            chunk=args.chunk,
-            queue_capacity=args.qcap,
-            fp_capacity=args.fpcap,
-            route_factor=args.routefactor,
-            pipeline=args.pipeline,
-            obs_slots=_obs_slots(args),
-        ), None
-    if args.fpset == "DiskFPSet":
-        # the OffHeapDiskFPSet/DiskStateQueue analog: authoritative dedup +
-        # frontier in the native (C++, disk-bounded) host tier.  Composes
-        # with -checkpoint (the disk tier's files ARE the snapshot, as in
-        # TLC) and with -sharded N (N fingerprint-space partitions - the
-        # distributed-fingerprint-server analog, launch:4)
-        from .engine.hybrid import check_hybrid
-
-        nparts = max(args.sharded, 1)
-        if nparts & (nparts - 1):
-            raise FileNotFoundError(
-                "-sharded with -fpset DiskFPSet needs a power-of-two "
-                f"partition count, got {nparts}"
-            )
-        return check_hybrid(
-            spec.model,
-            chunk=args.chunk,
-            fp_index=spec.fp_index,
-            fp_partitions=nparts,
-            ckpt_path=args.checkpoint or None,
-            ckpt_every=args.checkpointevery,
-            resume=args.recover,
-        ), None
-    if args.checkpoint or args.autogrow:
-        from .resil import check_supervised
-
-        sup = check_supervised(
-            spec.model,
-            chunk=args.chunk,
-            queue_capacity=args.qcap,
-            fp_capacity=args.fpcap,
-            fp_index=spec.fp_index,
-            pipeline=args.pipeline,
-            obs_slots=_obs_slots(args),
-            opts=_sup_opts(args, log),
-        )
-        return sup.result, sup
-    from .engine.bfs import check
-
-    return check(
-        spec.model,
-        chunk=args.chunk,
-        queue_capacity=args.qcap,
-        fp_capacity=args.fpcap,
-        fp_index=spec.fp_index,
-        pipeline=args.pipeline,
-        obs_slots=_obs_slots(args),
-    ), None
-
-
-def _preflight_gate(args, log, build_report):
-    """Run the preflight suite before a check (ISSUE 6 pipeline).
-
-    -no-preflight skips entirely; -analyze runs the deep mode (adds
-    the engine jaxpr purity trace - tracing only, no XLA compile).
-    Findings journal as schema-validated `analysis` events and render
-    as TLC-style warning banners (derived views of the same events, so
-    they cannot disagree); a clean preflight is silent.  Returns the
-    nonzero exit code on error-severity findings, None to proceed."""
-    if not args.preflight:
-        return None
-    from .analysis.report import emit_to_journal
-    from .obs.views import render_tlc_event
-
-    try:
-        report = build_report(args.analyze)
-    except Exception as e:  # a broken lint must never block a run
-        log.msg(1000, f"Preflight analysis skipped: {e}", severity=1)
-        return None
-    journal = getattr(args, "_journal", None)
-
-    def on_event(kind, info):
-        import time as _time
-
-        from .obs.schema import SCHEMA_VERSION
-
-        render_tlc_event(log, {"v": SCHEMA_VERSION, "t": _time.time(),
-                               "event": kind, **info})
-
-    emit_to_journal(journal, report, on_event=on_event)
-    if report.errors:
-        if journal is not None:
-            journal.event("final", verdict="error", generated=0,
-                          distinct=0, depth=0, queue=0, wall_s=0.0,
-                          interrupted=False)
-        log.msg(1000, "Preflight analysis found error-severity "
-                      "findings; run aborted (-no-preflight to "
-                      "override).", severity=1)
-        _finish_journal(args, log)
-        return report.exit_code
-    return None
-
-
-def _sup_opts(args, log):
-    """SupervisorOptions from the CLI flags.  Every supervisor event is
-    written to the run journal FIRST (the single source of truth), then
-    the TLC-style banner is rendered as a derived view of that journal
-    event (obs.views.render_tlc_event) - the 2200 Progress line and the
-    checkpoint/recovery/regrow banners cannot drift from what the
-    journal records."""
-    from .obs.views import render_tlc_event
-    from .resil import FaultPlan, SupervisorOptions
-
-    journal = getattr(args, "_journal", None)
-    resume_cmd = _resume_command(args)
-
-    def on_event(kind, info):
-        if journal is not None:
-            ev = journal.event(kind, **info)
-        else:
-            import time as _time
-
-            from .obs.schema import SCHEMA_VERSION
-
-            ev = {"v": SCHEMA_VERSION, "t": _time.time(),
-                  "event": kind, **info}
-        render_tlc_event(log, ev, resume_cmd=resume_cmd)
-
-    return SupervisorOptions(
-        auto_grow=args.autogrow,
-        max_regrow=args.maxregrow,
-        retries=args.retry,
-        ckpt_path=args.checkpoint or None,
-        ckpt_every=args.checkpointevery,
-        resume=args.recover,
-        spill=args.spill,
-        phase_timing=args.phasetiming,
-        faults=FaultPlan.parse(args.faults) if args.faults else None,
-        on_event=on_event,
-    )
-
-
-def _obs_slots(args) -> int:
-    """Counter-ring depth in effect: -no-obs disables the device tier
-    entirely (the A/B baseline; also the shape pre-obs checkpoints
-    expect), otherwise -obs-slots levels of history ride the carry."""
-    return args.obsslots if args.obs else 0
-
-
-def _open_journal(args, workload: str, engine: str, device: str,
-                  params: dict):
-    """Create the run journal and stamp the manifest.
-
-    Path resolution: -journal PATH wins; else a -checkpoint run
-    journals beside its snapshots (PATH.journal.jsonl) so preemption
-    and -recover find it; else the journal is in-memory only (still
-    powers -trace-out).  A -recover run APPENDS and stamps run_resume:
-    one continuous journal per logical run, not one per attempt."""
-    from . import __version__ as _v
-    from .obs.journal import RunJournal
-
-    path = args.journal or (
-        args.checkpoint + ".journal.jsonl" if args.checkpoint else ""
-    )
-    if not path and args.serve:
-        # the monitor serves journal FILES; an unjournaled -serve run
-        # gets one beside the temp dir (printed below via the server)
-        import tempfile
-
-        path = os.path.join(
-            tempfile.gettempdir(),
-            f"jaxtlc-{os.getpid()}.journal.jsonl",
-        )
-    resume = bool(args.recover and path and os.path.exists(path))
-    j = RunJournal(path or None, resume=resume)
-    if resume:
-        j.event("run_resume", version=_v, path=path)
-    else:
-        j.event("run_start", version=_v, workload=workload,
-                engine=engine, device=device, params=params)
-    args._journal = j
-    if args.serve:
-        # live ops plane: /metrics + /events (SSE) + /runs over this
-        # run's journal directory for the run's whole lifetime
-        from .obs.serve import start_server
-
-        args._server = start_server(
-            os.path.dirname(os.path.abspath(path)) or ".",
-            port=args.serve,
-        )
-        print(f"jaxtlc monitor at {args._server.url} "
-              "(/runs /metrics /events /journal)", file=sys.stderr)
-    return j
-
-
-def _finish_journal(args, log, r=None, sup=None, verdict: str = None,
-                    wall_s: float = 0.0) -> None:
-    """Close out the journal: the final event (when the supervisor did
-    not already emit one), the violation record, and the -trace-out
-    export (reading the WHOLE journal file so a resumed run's trace
-    covers both attempts)."""
-    j = getattr(args, "_journal", None)
-    if j is None:
-        return
-    try:
-        if r is not None and r.violation != 0:
-            j.event("violation", code=int(r.violation),
-                    name=r.violation_name)
-        if verdict == "liveness_violation":
-            j.event("violation", code=13,
-                    name="Temporal properties were violated")
-        if sup is None and r is not None:
-            v = verdict or ("violation" if r.violation != 0 else "ok")
-            j.event("final", verdict=v, generated=r.generated,
-                    distinct=r.distinct, depth=r.depth,
-                    queue=r.queue_left, wall_s=round(wall_s, 6),
-                    interrupted=False)
-        if args.traceout:
-            from .obs.journal import read as read_journal
-            from .obs.trace import export_chrome_trace
-
-            events = read_journal(j.path, validate=False) if j.path \
-                else j.events
-            n = export_chrome_trace(events, args.traceout)
-            j.event("trace_export", path=args.traceout, events=n)
-            log.msg(1000, f"Timeline trace written to {args.traceout} "
-                          f"({n} events; open in ui.perfetto.dev).")
-    finally:
-        j.close()
-        args._journal = None
-        server = getattr(args, "_server", None)
-        if server is not None:
-            server.shutdown()
-            args._server = None
-
-
-def _resume_command(args) -> str:
-    """The command an interrupted run prints (geometry travels inside the
-    checkpoint meta, so only the run-shaping flags need repeating)."""
-    parts = ["python -m jaxtlc.cli check", args.config]
-    if args.checkpoint:
-        parts += ["-checkpoint", args.checkpoint, "-recover"]
-    if args.chunk != 1024:
-        parts += ["-chunk", str(args.chunk)]
-    if args.sharded:
-        parts += ["-sharded", str(args.sharded)]
-    if args.pipeline:
-        parts += ["-pipeline"]  # checkpoints only resume in the same mode
-    if args.frontend != "auto":
-        parts += ["-frontend", args.frontend]
-    if not args.checkpoint:
-        return ("re-run from scratch (no -checkpoint was set): "
-                + " ".join(parts))
-    return " ".join(parts)
-
-
-def _render_sources(cfg_path: str, spec_name: str) -> dict:
-    """Rendering inputs derived from the model directory (M4): the
-    action-line table scanned from the spec's committed translation, and
-    the Toolbox .pmap (generated-TLA -> PlusCal source map) when present."""
-    import os
-
-    out = {}
-    model_dir = os.path.dirname(os.path.abspath(cfg_path))
-    tla = os.path.join(model_dir, f"{spec_name}.tla")
-    if os.path.exists(tla):
-        from .io.tlc_log import action_lines_from_spec
-
-        out["action_lines"] = action_lines_from_spec(tla)
-    pmap_path = os.path.join(
-        os.path.dirname(model_dir), f"{spec_name}.tla.pmap"
-    )
-    if os.path.exists(pmap_path):
-        from .frontend.pmap import PmapError, parse_pmap_file
-
-        try:
-            out["pcal_map"] = parse_pmap_file(pmap_path)
-        except PmapError:
-            pass  # a corrupt pmap must not break the run (Toolbox parity)
-    return out
-
-
-def _sany_inputs(cfg_path: str, spec_name: str):
-    """Files actually read + modules resolved, for the SANY log section."""
-    import os
-
-    model_dir = os.path.dirname(os.path.abspath(cfg_path))
-    files, modules = [], []
-    # TLC's order (MC.out:8-24): the root MC.tla parses first, semantic
-    # processing finishes with the root module last
-    mc = os.path.join(model_dir, "MC.tla")
-    if os.path.exists(mc):
-        files.append(mc)
-    sp = os.path.join(model_dir, f"{spec_name}.tla")
-    if os.path.exists(sp):
-        files.append(sp)
-        modules.append(spec_name)
-    if os.path.exists(mc):
-        modules.append("MC")
-    return files, modules
-
-
-def _run_check_gen(args, spec) -> int:
-    """Check a generic-frontend spec (E1): device engine + host liveness.
-
-    -sharded runs the gen lane kernel through the mesh engine (the same
-    fp-space partition + all_to_all routing as the KubeAPI path);
-    -checkpoint/-recover snapshot the whole sharded carry (a 1-device
-    mesh when -sharded is not given), mirroring TLC applying its
-    distribution/checkpoint machinery to any spec."""
-    from .gen import oracle as go
-    from .gen.engine import check_gen
-
-    g = spec.genspec
-
-    def props():
-        for name, (p_ast, q_ast) in g.properties.items():
-            yield name, p_ast, q_ast, None
-
-    def check():
-        if not (args.sharded or args.checkpoint):
-            return check_gen(
-                g,
-                chunk=args.chunk,
-                queue_capacity=args.qcap,
-                fp_capacity=args.fpcap,
-                fp_index=spec.fp_index,
-                check_deadlock=spec.check_deadlock,
-            )
-        import jax
-        import numpy as np
-        from jax.sharding import Mesh
-
-        from .engine.sharded import (
-            check_sharded,
-            check_sharded_with_checkpoints,
-            gen_backend,
-        )
-
-        n_dev = args.sharded or 1
-        mesh = Mesh(np.array(jax.devices()[:n_dev]), ("fp",))
-        backend = gen_backend(g)
-        kw = dict(
-            chunk=args.chunk,
-            queue_capacity=args.qcap,
-            fp_capacity=args.fpcap,
-            route_factor=args.routefactor,
-            backend=backend,
-            pipeline=args.pipeline,
-            obs_slots=_obs_slots(args),
-        )
-        if args.checkpoint:
-            meta_config = {
-                "spec": spec.spec_name,
-                "constants": {
-                    k: sorted(v) if isinstance(v, frozenset) else v
-                    for k, v in g.constants.items()
-                },
-            }
-            return check_sharded_with_checkpoints(
-                None, mesh, ckpt_path=args.checkpoint,
-                ckpt_every=args.checkpointevery, resume=args.recover,
-                meta_config=meta_config, **kw,
-            )
-        return check_sharded(None, mesh, **kw)
-
-    def leads_to(name, p, q, distinct=0):
-        from .live.check import check_leads_to_device, use_device_path
-
-        if use_device_path(distinct, args.fairness, args.liveness_host):
-            mesh = None
-            if args.sharded:
-                import jax
-                import numpy as np
-                from jax.sharding import Mesh
-
-                mesh = Mesh(np.array(jax.devices()[: args.sharded]),
-                            ("fp",))
-            return check_leads_to_device(
-                g, p, q, name, chunk=args.chunk,
-                state_capacity=args.fpcap, fp_capacity=args.fpcap,
-                mesh=mesh, spill_path=args.checkpoint or None,
-            )
-        return go.check_leads_to(g, p, q, name, fairness=args.fairness)
-
-    kit = _InterpKit(
-        kind="generic",
-        extra_unsupported=(
-            ("-nodeadlock with -sharded/-checkpoint",
-             (args.sharded or args.checkpoint)
-             and not spec.check_deadlock),
-        ),
-        check=lambda: (check(), None),
-        init_count=lambda: 1,
-        properties=props,
-        check_leads_to=leads_to,
-        fairness_label=args.fairness,
-        state_to_tla=lambda st: go.state_to_tla(g, st),
-        state_env=lambda st: go.state_env(g, st),
-        violation_trace=lambda: go.violation_trace(
-            g, check_deadlock=spec.check_deadlock
-        ),
-        coverage=lambda: _gen_coverage_lines(spec, g),
-        preflight=lambda deep: _gen_preflight(args, g, deep),
-    )
-    return _run_check_interp(args, spec, kit)
-
-
-def _gen_preflight(args, g, deep):
-    from .analysis.preflight import preflight_gen
-
-    return preflight_gen(g, fp_capacity=args.fpcap, deep=deep)
-
-
-def _gen_coverage_lines(spec, g):
-    from .gen.coverage import coverage_walk, render_coverage
-
-    text = ""
-    if spec.tla_path:
-        try:
-            with open(spec.tla_path) as f:
-                text = f.read()
-        except OSError:
-            pass
-    init_count, cov = coverage_walk(g, text)
-    return render_coverage(
-        spec.spec_name, init_count, cov,
-        time.strftime("%Y-%m-%d %H:%M:%S"),
-    )
-
-
-def _run_check_struct(args, spec) -> int:
-    """Check a structural-frontend spec (E1): the full-module path that
-    runs specs outside the gen subset - the reference's own KubeAPI.tla
-    included.  The LaneCompiler step is a first-class engine kernel now:
-    struct runs ride the production engines - segmented + supervised by
-    default (auto-regrow, checkpoints, SIGTERM drain), mesh-sharded
-    with -sharded - with the persistent step-compile cache warm-starting
-    repeated runs.  Host graph for liveness, host re-run for traces;
-    same log protocol and exit conventions."""
-    from .struct import oracle as so
-    from .struct.backend import struct_meta_config
-    from .struct.cache import get_backend
-    from .struct.engine import check_struct, check_struct_sharded
-
-    sm = spec.structmodel
-    system = sm.system
-    if args.recover and not args.checkpoint:
-        print("Error: -recover requires -checkpoint PATH", file=sys.stderr)
-        return 1
-    log_holder = []
-
-    def check():
-        log = log_holder[0]
-        ckd = spec.check_deadlock
-        kw = dict(chunk=args.chunk, queue_capacity=args.qcap,
-                  fp_capacity=args.fpcap)
-        if args.sharded:
-            import numpy as np
-            import jax
-            from jax.sharding import Mesh
-
-            mesh = Mesh(np.array(jax.devices()[: args.sharded]), ("fp",))
-            if args.checkpoint or args.autogrow:
-                from .resil import check_sharded_supervised
-
-                sup = check_sharded_supervised(
-                    None, mesh, backend=get_backend(sm, ckd),
-                    meta_config=struct_meta_config(sm),
-                    route_factor=args.routefactor,
-                    pipeline=args.pipeline,
-                    obs_slots=_obs_slots(args),
-                    opts=_sup_opts(args, log), **kw,
-                )
-                return sup.result, sup
-            return check_struct_sharded(
-                sm, mesh, route_factor=args.routefactor,
-                check_deadlock=ckd, pipeline=args.pipeline,
-                obs_slots=_obs_slots(args), **kw,
-            ), None
-        if args.checkpoint or args.autogrow:
-            from .resil import check_supervised
-
-            sup = check_supervised(
-                None, fp_index=spec.fp_index,
-                backend=get_backend(sm, ckd),
-                meta_config=struct_meta_config(sm), check_deadlock=ckd,
-                pipeline=args.pipeline,
-                obs_slots=_obs_slots(args),
-                opts=_sup_opts(args, log), **kw,
-            )
-            return sup.result, sup
-        return check_struct(
-            sm, fp_index=spec.fp_index, check_deadlock=ckd,
-            pipeline=args.pipeline, obs_slots=_obs_slots(args), **kw,
-        ), None
-
-    def props():
-        for name in spec.properties:
-            ast = sm.properties[name]
-            if ast[0] != "leadsto" or ast[1][0] == "box":
-                yield name, None, None, (
-                    "only plain P ~> Q is checked on the structural path"
-                )
-                continue
-            yield name, ast[1], ast[2], None
-
-    def action_order():
-        # MC.out prints actions in module-definition order; lane labels
-        # ARE definition names, so def_order is the rendering order
-        names = set(get_backend(sm, spec.check_deadlock).labels)
-        ordered = [n for n in sm.module.def_order if n in names]
-        return ordered + [n for n in sorted(names) if n not in ordered]
-
-    kit = _InterpKit(
-        kind="structural",
-        # the structural liveness graph is wf_next-only so far
-        extra_unsupported=(
-            ("-fairness wf_process", args.fairness == "wf_process"),
-        ),
-        check=check,
-        # lazy: Init enumeration is real work on struct specs and must
-        # not run when the flags are about to be rejected
-        init_count=lambda: len(system.initial_states()),
-        properties=props,
-        check_leads_to=lambda name, p, q, **_kw: so.check_leads_to(
-            system, p, q, name
-        ),
-        fairness_label="wf_next",
-        state_to_tla=lambda st: so.state_to_tla(system, st),
-        state_env=lambda st: so.state_env(system, st),
-        violation_trace=lambda: so.violation_trace(
-            system, sm.invariants, check_deadlock=spec.check_deadlock
-        ),
-        action_order=action_order,
-        preflight=lambda deep: _struct_preflight(args, spec, sm, deep),
-    )
-    return _run_check_interp(args, spec, kit, log_holder=log_holder)
-
-
-def _struct_preflight(args, spec, sm, deep):
-    from .analysis.preflight import preflight_struct
-
-    backend = None
-    if deep:
-        # the same memoized backend the run is about to use: the deep
-        # audit adds a jaxpr trace, never a second lane compile
-        from .struct.cache import get_backend
-
-        backend = get_backend(sm, spec.check_deadlock)
-    return preflight_struct(
-        sm, fp_capacity=args.fpcap, chunk=args.chunk,
-        queue_capacity=args.qcap, check_deadlock=spec.check_deadlock,
-        deep=deep, backend=backend,
-    )
-
-
-class _InterpKit:
-    """Everything the shared interpreted-spec runner needs from a
-    frontend: one object so the gen/struct runners cannot drift."""
-
-    def __init__(self, kind, extra_unsupported, check, init_count,
-                 properties, check_leads_to, fairness_label,
-                 state_to_tla, state_env, violation_trace,
-                 coverage=None, action_order=None, preflight=None):
-        self.kind = kind
-        self.extra_unsupported = extra_unsupported
-        self.check = check  # () -> (CheckResult, SupervisedResult | None)
-        self.init_count = init_count
-        self.properties = properties
-        self.check_leads_to = check_leads_to
-        self.fairness_label = fairness_label
-        self.state_to_tla = state_to_tla
-        self.state_env = state_env
-        self.violation_trace = violation_trace
-        self.coverage = coverage  # () -> dump lines, or None
-        self.action_order = action_order  # () -> coverage line order
-        self.preflight = preflight  # (deep) -> AnalysisReport, or None
-
-
-def _run_check_interp(args, spec, kit: "_InterpKit",
-                      log_holder: list = None) -> int:
-    """Shared runner for the interpreted frontends (gen + struct): the
-    KubeAPI-engine knobs are rejected, the device engine checks safety,
-    the host graph checks liveness, and violations re-run on the host
-    interpreter for the trace.  TLC log protocol + exit conventions."""
-    unsupported = [
-        flag for flag, on in (
-            ("-fpset DiskFPSet", args.fpset != "JaxFPSet"),
-            ("-mutation", args.mutation),
-            *kit.extra_unsupported,
-        ) if on
-    ]
-    if unsupported:
-        print(
-            f"Error: {', '.join(unsupported)} not supported for "
-            f"{kit.kind}-frontend specs yet",
-            file=sys.stderr,
-        )
-        return 1
-    log = TLCLog(tool_mode=not args.noTool)
-    if log_holder is not None:
-        log_holder.append(log)
-    import jax
-
-    device = str(jax.devices()[0])
-    log.version(__version__)
-    log.banner(spec.fp_index, DEFAULT_SEED, spec.workers, device)
-    log.sany(*_sany_inputs(args.config, spec.spec_name))
-    log.starting()
-    log.computing_init()
-    _open_journal(
-        args, workload=spec.spec_name,
-        engine="sharded" if args.sharded else "single",
-        device=device,
-        params=dict(chunk=args.chunk, queue_capacity=args.qcap,
-                    fp_capacity=args.fpcap, sharded=args.sharded,
-                    pipeline=args.pipeline, frontend=kit.kind,
-                    obs_slots=_obs_slots(args)),
-    )
-    if kit.preflight is not None:
-        rc = _preflight_gate(args, log, kit.preflight)
-        if rc is not None:
-            return rc
-    t0 = time.time()
-    from .resil import SlotOverflowError
-
-    try:
-        with _xprof(args):
-            r, sup = kit.check()
-    except SlotOverflowError as e:
-        log.msg(1000, f"Run stopped: {e}", severity=1)
-        _finish_journal(args, log)
-        return 1
-    except FileNotFoundError as e:
-        print(f"Error: {e}", file=sys.stderr)
-        _finish_journal(args, log)
-        return 1
-    n_init = kit.init_count()
-    log.init_done(n_init)
-    if sup is not None and sup.interrupted:
-        # the interrupted banner (with the resume command) was emitted
-        # by the supervisor's event hook
-        from .resil import EXIT_INTERRUPTED
-
-        log.progress(r.depth, r.generated, r.distinct, r.queue_left)
-        log.final_counts(r.generated, r.distinct, r.queue_left)
-        _finish_journal(args, log, r=None, sup=sup)
-        return EXIT_INTERRUPTED
-    violated = r.violation != 0
-    liveness_violated = False
-    if not violated and spec.properties:
-        from .live.check import use_device_path
-
-        log.checking_temporal(
-            r.distinct,
-            "device" if kit.kind == "generic" and use_device_path(
-                r.distinct, args.fairness, args.liveness_host
-            ) else "host",
-        )
-        for name, p_ast, q_ast, skip in kit.properties():
-            if skip is not None:
-                log.msg(1000, f"Temporal property {name} skipped: "
-                              f"{skip}.", severity=1)
-                continue
-            res = kit.check_leads_to(name, p_ast, q_ast,
-                                     distinct=r.distinct)
-            if res.holds:
-                log.msg(1000, f"Temporal property {name} holds "
-                              f"(fairness: {kit.fairness_label}).")
-                continue
-            liveness_violated = True
-            log.msg(2116, f"Temporal properties were violated: {name}",
-                    severity=1)
-            idx = 1
-            for st in res.lasso_prefix:
-                log.trace_state(idx, None, kit.state_to_tla(st))
-                idx += 1
-            log.msg(1000, "-- The following states form a cycle "
-                          "(back to the first of them) --")
-            for st in res.lasso_cycle:
-                log.trace_state(idx, None, kit.state_to_tla(st))
-                idx += 1
-    if violated:
-        log.msg(2110 if r.violation >= 100 else 1000,
-                r.violation_name, severity=1)
-        found = kit.violation_trace()
-        if found is None:
-            log.msg(1000, "Violation was not reproducible in host mode",
-                    severity=1)
-        else:
-            expr_rows = None
-            if args.traceExpressions:
-                # trace-explorer re-evaluation over interpreted states
-                from .spec.texpr import (
-                    TexprError,
-                    eval_over_envs,
-                    parse_expressions,
-                )
-
-                try:
-                    with open(args.traceExpressions) as f:
-                        exprs = parse_expressions(f.read())
-                    expr_rows = eval_over_envs(
-                        exprs,
-                        [kit.state_env(st) for st, _ in found[1]],
-                    )
-                except (OSError, TexprError) as e:
-                    log.msg(1000, f"Trace expressions skipped: {e}",
-                            severity=1)
-            for i, (st, act) in enumerate(found[1], start=1):
-                head = (f"State {i}: <Initial predicate>" if act is None
-                        else f"State {i}: <{act}>")
-                text = kit.state_to_tla(st)
-                if expr_rows is not None:
-                    from .spec.pretty import value_to_tla
-
-                    text += "".join(
-                        f"\n/\\ {res.name} = "
-                        + (f"<evaluation failed: {res.value}>"
-                           if res.failed else value_to_tla(res.value))
-                        for res in expr_rows[i - 1]
-                    )
-                log.msg(2217, head + "\n" + text, severity=1)
-    elif not liveness_violated:
-        log.success(r.generated, r.distinct,
-                    getattr(r, "actual_fp_collision", None),
-                    occupancy=getattr(r, "fp_occupancy", None))
-        if args.coverage and kit.coverage is not None:
-            # full per-expression dump: host re-walk with instrumented
-            # evaluation, the KubeAPI path's discipline applied to the
-            # generic frontend (slow for large configs, like TLC's own
-            # coverage mode)
-            log.coverage_gen_dump(kit.coverage())
-        else:
-            act_gen, act_dist = r.action_generated, r.action_distinct
-            if kit.action_order is not None:
-                # per-action lines in module-definition (MC.out) order,
-                # zero-fire actions printed 0:0 exactly as TLC does
-                order = kit.action_order()
-                act_gen = {a: act_gen.get(a, 0) for a in order}
-                act_dist = {a: act_dist.get(a, 0) for a in order}
-            log.coverage_generic(spec.spec_name, n_init,
-                                 act_gen, act_dist)
-    log.progress(r.depth, r.generated, r.distinct, r.queue_left)
-    log.final_counts(r.generated, r.distinct, r.queue_left)
-    log.depth(r.depth)
-    log.finished(int((time.time() - t0) * 1000))
-    _finish_journal(
-        args, log, r=r, sup=sup,
-        verdict="liveness_violation" if liveness_violated else None,
-        wall_s=time.time() - t0,
-    )
-    if violated:
-        return 12
-    return 13 if liveness_violated else 0
-
-
-def _print_trace(log: TLCLog, model: ModelConfig, chunk: int,
-                 trace_expr_file: str = "",
-                 check_deadlock: bool = True) -> None:
-    from .engine.trace import find_violation_trace
-    from .spec.pretty import state_to_tla
-
-    found = find_violation_trace(model, chunk=chunk,
-                                 check_deadlock=check_deadlock)
-    if found is None:
-        log.msg(1000, "Violation was not reproducible in host mode", severity=1)
-        return
-    _, trace = found
-    expr_rows = None
-    if trace_expr_file:
-        # the Toolbox trace-explorer pass (MC_TE.out slot): evaluate each
-        # user expression in every trace state, shown as extra conjuncts.
-        # A bad/missing expression file must never lose the trace itself.
-        from .spec.pretty import value_to_tla
-        from .spec.texpr import TexprError, eval_over_trace, parse_expressions
-
-        try:
-            with open(trace_expr_file) as f:
-                exprs = parse_expressions(f.read())
-            expr_rows = eval_over_trace(exprs, trace, model)
-        except (OSError, TexprError) as e:
-            log.msg(1000, f"Trace expressions skipped: {e}", severity=1)
-    for i, (st, act) in enumerate(trace, start=1):
-        text = state_to_tla(st, model)
-        if expr_rows is not None:
-            text += "".join(
-                f"\n/\\ {res.name} = "
-                + (f"<evaluation failed: {res.value}>" if res.failed
-                   else value_to_tla(res.value))
-                for res in expr_rows[i - 1]
-            )
-        log.trace_state(i, act, text)
+# The check orchestration lives in jaxtlc.api now (the engine-as-a-
+# library refactor, ISSUE 9): this module is the argparse shim.  The
+# names below are re-exported for callers that grew up against the old
+# CLI-owns-everything layout (tests, tools).
+from .api import (  # noqa: F401 - compatibility re-exports
+    CheckRequest,
+    CheckOutcome,
+    run_check,
+    _dispatch_check,
+    _finish_journal,
+    _open_journal,
+    _preflight_gate,
+    _resume_command,
+)
 
 
 def main(argv=None) -> int:
@@ -1306,7 +266,7 @@ def main(argv=None) -> int:
     elif args.compilecache:
         os.environ["JAXTLC_COMPILE_CACHE"] = args.compilecache
     if args.cmd == "check":
-        return _run_check(args)
+        return run_check(CheckRequest.from_args(args)).exit_code
     return 1
 
 

@@ -572,8 +572,15 @@ def make_backend_engine(
     label_ids = jnp.arange(n_labels, dtype=jnp.int32)
     ncand_full = chunk * L
 
-    def init_fn() -> EngineCarry:
-        inits = jnp.asarray(backend.initial_vectors())
+    def init_fn(inits=None) -> EngineCarry:
+        # `inits` overrides the backend's Init set ([n0, F] int32 field
+        # vectors): the constant-config sweep engine (jaxtlc.serve.sweep)
+        # seeds one carry per configuration through the same packing /
+        # fpset-insert / init-invariant path, so a seeded carry is
+        # exactly what a backend with that Init would have produced
+        if inits is None:
+            inits = backend.initial_vectors()
+        inits = jnp.asarray(inits)
         n0 = inits.shape[0]
         assert n0 <= chunk and n0 <= qcap, "raise chunk/queue_capacity"
         packed0 = cdc.pack(inits)

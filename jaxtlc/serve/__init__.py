@@ -1,0 +1,25 @@
+"""jaxtlc.serve - checking as a service (ROADMAP #4).
+
+A persistent, multi-job checking service assembled from the pieces
+earlier rounds built: the struct compile cache (PR 3) becomes a warm
+AOT `EnginePool` (serve.pool), the run journal + monitoring server
+(PR 5/8) become the per-job telemetry surface (serve.server subclasses
+obs.serve), the MC.cfg constant-override layer becomes a vmapped batch
+axis (serve.sweep), and `jaxtlc.api.run_check` - the engine-as-a-
+library refactor this package forced - runs the large jobs under the
+resil supervisor (serve.scheduler).
+
+``python -m jaxtlc.serve`` starts the server; ``jaxtlc.serve.client``
+submits; ``tools/loadgen.py`` load-tests the warm path.
+"""
+
+from .pool import CompileMeter, EnginePool, xla_compiles  # noqa: F401
+from .scheduler import Job, JobError, Scheduler  # noqa: F401
+from .server import CheckServer, start_server  # noqa: F401
+from .sweep import (  # noqa: F401
+    SweepEngine,
+    SweepError,
+    class_key,
+    load_anchored,
+    sweep_backend,
+)

@@ -1,0 +1,278 @@
+"""Warm AOT engine pool: the serving tier's compile amortizer.
+
+A checking service lives or dies on cold-start amortization (the
+TensorFlow-serving lesson in PAPERS.md: compile the graph once, serve
+it forever).  The pool holds FULLY COMPILED engines - the AOT
+executable, not just the jit closures - keyed by the struct-cache memo
+key for plain engines (`struct.cache.engine_key`: spec digest x
+canonical constants x geometry x pipeline/obs flags) and by the
+constants-CLASS key for sweep engines (`sweep.class_key`: the swept
+values drop out, which is what lets one entry serve a whole config
+portfolio).  LRU eviction bounds a long-lived process; hit/miss/
+eviction/compile counters make the warm-path contract assertable.
+
+The contract - **warm submit performs ZERO fresh XLA compiles** - is
+pinned by `CompileMeter`, which counts jax's own
+`/jax/core/compile/backend_compile_duration` monitoring events: every
+real backend compile fires one, a warm AOT call fires none, so a test
+(and `tools/loadgen.py --tiny`) can assert the meter's delta across a
+resubmit is exactly zero.  Our own `compiles` counter says when the
+POOL built; the meter says what XLA actually did - the two together
+catch both a broken pool key and a silently-recompiling executable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from ..engine.fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
+
+
+class CompileMeter:
+    """Process-wide XLA backend-compile counter (jax.monitoring).
+
+    Counts `/jax/core/compile/backend_compile_duration` events - fired
+    once per real XLA compile (AOT .compile() included, persistent-
+    cache hits included: deserialization still passes through the
+    event), never by a warm executable call.  Monotonic; assert on
+    deltas."""
+
+    _instance: Optional["CompileMeter"] = None
+
+    def __init__(self):
+        self.count = 0
+        self.wall_s = 0.0
+        self._lock = threading.Lock()
+        from jax._src import monitoring
+
+        def on_event(name, duration, **kw):
+            if name.endswith("backend_compile_duration"):
+                with self._lock:
+                    self.count += 1
+                    self.wall_s += float(duration)
+
+        monitoring.register_event_duration_secs_listener(on_event)
+
+    @classmethod
+    def instance(cls) -> "CompileMeter":
+        if cls._instance is None:
+            cls._instance = CompileMeter()
+        return cls._instance
+
+
+def xla_compiles() -> int:
+    """Monotonic count of real XLA compiles this process performed."""
+    return CompileMeter.instance().count
+
+
+class PoolEntry:
+    """One warm engine: the AOT executable plus everything needed to
+    run a job against it without touching the compiler."""
+
+    def __init__(self, key, kind: str, runner, meta: dict):
+        self.key = key
+        self.kind = kind  # "single" | "sweep"
+        self.runner = runner  # _SingleRunner | sweep.SweepEngine
+        self.meta = meta
+        self.built_t = time.time()
+        self.last_used = self.built_t
+        self.uses = 0
+
+
+class _SingleRunner:
+    """AOT wrapper for one plain struct engine (one model, one config):
+    compile once at build, fresh carry + warm executable per job."""
+
+    def __init__(self, model, chunk, queue_capacity, fp_capacity,
+                 fp_index, seed, check_deadlock, pipeline, obs_slots):
+        from ..engine.bfs import DEFAULT_FP_HIGHWATER
+        from ..struct.cache import get_backend, get_engine
+
+        self.model = model
+        self.fp_capacity = fp_capacity
+        self.backend = get_backend(model, check_deadlock)
+        init_fn, run_fn, _ = get_engine(
+            model, chunk, queue_capacity, fp_capacity, fp_index, seed,
+            DEFAULT_FP_HIGHWATER, check_deadlock=check_deadlock,
+            pipeline=pipeline, obs_slots=obs_slots,
+        )
+        import jax
+
+        # the engine memo shares jit closures; the POOL owns the AOT
+        # executables so a warm submit never re-lowers or re-traces
+        # (lower().compile() bypasses the jit call cache, and an EAGER
+        # init_fn re-compiles its fpset while_loop per call - both
+        # would make every submit of a memo-hit engine pay fresh XLA
+        # compiles; the zero-compile warm contract pins this)
+        self._mk_carry = jax.jit(lambda: init_fn())
+        carry0 = self._mk_carry()
+        self._aot = run_fn.lower(carry0).compile()
+
+    def run(self):
+        import jax
+
+        from ..engine.bfs import result_from_carry
+        from ..struct.backend import struct_viol_names
+
+        carry = self._mk_carry()
+        t0 = time.time()
+        out = jax.block_until_ready(self._aot(carry))
+        wall = time.time() - t0
+        return result_from_carry(
+            out, wall, fp_capacity=self.fp_capacity,
+            labels=self.backend.labels,
+            viol_names=struct_viol_names(self.model),
+        )
+
+
+class EnginePool:
+    """LRU pool of warm AOT engines (thread-safe: the HTTP handlers
+    read stats while the scheduler thread builds/runs)."""
+
+    def __init__(self, capacity: int = 8,
+                 sweep_width: int = None):
+        from .sweep import DEFAULT_WIDTH
+
+        self.capacity = max(1, int(capacity))
+        self.sweep_width = sweep_width or DEFAULT_WIDTH
+        self._entries: "OrderedDict[tuple, PoolEntry]" = OrderedDict()
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.compiles = 0  # pool-level builds (one per miss)
+        self.compile_wall_s = 0.0
+        CompileMeter.instance()  # start metering before the first build
+
+    # -- lookup ------------------------------------------------------------
+
+    def _get_or_build(self, key, build, kind: str, meta: dict):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self.hits += 1
+                hit.uses += 1
+                hit.last_used = time.time()
+                self._entries.move_to_end(key)
+                return hit
+            self.misses += 1
+        # build OUTSIDE the lock: compiles are seconds-to-minutes and
+        # stats reads must not block behind them
+        t0 = time.time()
+        runner = build()
+        wall = time.time() - t0
+        entry = PoolEntry(key, kind, runner, meta)
+        with self._lock:
+            self.compiles += 1
+            self.compile_wall_s += wall
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+        return entry
+
+    def get_single(
+        self,
+        model,
+        chunk: int = 64,
+        queue_capacity: int = 1 << 10,
+        fp_capacity: int = 1 << 12,
+        fp_index: int = DEFAULT_FP_INDEX,
+        seed: int = DEFAULT_SEED,
+        check_deadlock: bool = True,
+        pipeline: bool = False,
+        obs_slots: int = 0,
+    ) -> PoolEntry:
+        """Warm plain engine for (model meaning, geometry) - keyed on
+        the struct-cache memo key, so pool identity == memo identity."""
+        from ..engine.bfs import DEFAULT_FP_HIGHWATER
+        from ..struct.cache import engine_key
+
+        key = engine_key(
+            model, chunk, queue_capacity, fp_capacity, fp_index, seed,
+            DEFAULT_FP_HIGHWATER, check_deadlock=check_deadlock,
+            pipeline=pipeline, obs_slots=obs_slots,
+        )
+        return self._get_or_build(
+            key,
+            lambda: _SingleRunner(
+                model, chunk, queue_capacity, fp_capacity, fp_index,
+                seed, check_deadlock, pipeline, obs_slots,
+            ),
+            "single",
+            dict(workload=model.root_name, chunk=chunk,
+                 fp_capacity=fp_capacity),
+        )
+
+    def get_sweep(
+        self,
+        model,
+        params: Dict[str, Tuple[int, int]],
+        chunk: int = 64,
+        queue_capacity: int = 1 << 10,
+        fp_capacity: int = 1 << 12,
+        fp_index: int = DEFAULT_FP_INDEX,
+        seed: int = DEFAULT_SEED,
+        check_deadlock: bool = True,
+    ) -> PoolEntry:
+        """Warm constants-class sweep engine: one entry per CLASS (the
+        swept values are runtime data, not key material)."""
+        from .sweep import SweepEngine, class_key
+
+        key = ("sweep", class_key(model, params), chunk, queue_capacity,
+               fp_capacity, fp_index, seed, bool(check_deadlock),
+               int(self.sweep_width))
+        return self._get_or_build(
+            key,
+            lambda: SweepEngine(
+                model, params, chunk=chunk,
+                queue_capacity=queue_capacity, fp_capacity=fp_capacity,
+                fp_index=fp_index, seed=seed,
+                check_deadlock=check_deadlock, width=self.sweep_width,
+            ),
+            "sweep",
+            dict(workload=model.root_name, chunk=chunk,
+                 fp_capacity=fp_capacity,
+                 params={c: list(d) for c, d in sorted(params.items())}),
+        )
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Pool + memo + compile-meter counters (the /pool endpoint)."""
+        from ..struct import cache as struct_cache
+
+        meter = CompileMeter.instance()
+        with self._lock:
+            entries = [
+                dict(kind=e.kind, uses=e.uses,
+                     built_t=round(e.built_t, 3),
+                     last_used=round(e.last_used, 3), **e.meta)
+                for e in self._entries.values()
+            ]
+            return dict(
+                capacity=self.capacity,
+                size=len(self._entries),
+                hits=self.hits,
+                misses=self.misses,
+                evictions=self.evictions,
+                compiles=self.compiles,
+                compile_wall_s=round(self.compile_wall_s, 6),
+                xla_compiles=meter.count,
+                xla_compile_wall_s=round(meter.wall_s, 6),
+                sweep_width=self.sweep_width,
+                memo=struct_cache.stats(),
+                entries=entries,
+            )
+
+    def keys(self) -> List[tuple]:
+        with self._lock:
+            return list(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()

@@ -1,0 +1,442 @@
+"""FIFO job scheduler: many checks, one device, warm engines.
+
+The queue discipline of the checking service (serve.server): jobs run
+in submission order, but the scheduler looks ahead for **compatible
+small jobs** - same spec text, same cfg, same geometry, same sweep
+descriptor, constants differing only in the swept names - and folds up
+to `pool.sweep_width` of them into ONE vmapped dispatch through the
+constants-class sweep engine.  Everything else runs alone:
+
+* small struct jobs without a sweep descriptor go through the pool's
+  warm plain engine (AOT executable; warm submit = zero fresh XLA
+  compiles - the pool's assertable contract);
+* large jobs (geometry above `large_fpcap`, or any resilience option:
+  checkpoint/recover/sharded/liveness/faults) route through
+  `api.run_check`, i.e. the resil supervisor with auto-regrow, the
+  degradation ladder, and the full TLC transcript.
+
+Every job writes its own journal into the server root - the /runs
+registry and the job-scoped SSE stream (`/events?run=<job id>`) are the
+existing obs.serve machinery reading those files.  Scheduler-run jobs
+journal in batched-fsync mode (obs.journal fsync_every): job journals
+are high-rate telemetry, and a crash loses at most a tail the
+scheduler re-reports in the job record anyway.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+from .pool import EnginePool
+
+JOB_FSYNC_EVERY = 16  # batched-fsync journals for scheduler-run jobs
+DEFAULT_LARGE_FPCAP = 1 << 16  # above this, a job is "large"
+
+# job options forwarded to api.CheckRequest on the supervised path
+_REQUEST_OPTIONS = (
+    "workers", "frontend", "chunk", "qcap", "fpcap", "pipeline",
+    "sharded", "checkpoint", "recover", "liveness", "fairness",
+    "nodeadlock", "faults", "retry", "maxregrow", "spill", "obs",
+    "obsslots", "coverage",
+)
+_HEAVY_OPTIONS = ("checkpoint", "recover", "sharded", "liveness",
+                  "faults", "coverage")
+
+
+class JobError(ValueError):
+    pass
+
+
+class Job:
+    """One submitted check: spec + cfg text, optional constant
+    overrides, optional sweep descriptor, engine options."""
+
+    def __init__(self, spec: str, cfg: str, name: str = "",
+                 constants: Optional[dict] = None,
+                 sweep: Optional[dict] = None,
+                 options: Optional[dict] = None):
+        self.id = f"job-{uuid.uuid4().hex[:10]}"
+        self.spec = spec
+        self.cfg = cfg
+        self.name = name or self.id
+        self.constants = dict(constants or {})
+        self.sweep = dict(sweep) if sweep else None
+        self.options = dict(options or {})
+        self.state = "queued"  # queued | running | done | error
+        self.result: Optional[dict] = None
+        self.error: Optional[str] = None
+        self.engine = ""  # "sweep" | "pool" | "supervised"
+        self.submitted_t = time.time()
+        self.started_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+
+    # -- routing -----------------------------------------------------------
+
+    def sweep_params(self) -> Dict[str, tuple]:
+        """{const: (lo, hi)} from the job's sweep descriptor."""
+        if not self.sweep:
+            return {}
+        c = self.sweep.get("const")
+        if not c:
+            raise JobError("sweep descriptor needs a 'const' name")
+        lo, hi = int(self.sweep.get("lo", 0)), int(self.sweep["hi"])
+        return {c: (lo, hi)}
+
+    def is_large(self, large_fpcap: int) -> bool:
+        if any(self.options.get(k) for k in _HEAVY_OPTIONS):
+            return True
+        return int(self.options.get("fpcap", 1 << 12)) > large_fpcap
+
+    def batch_signature(self) -> str:
+        """Jobs with equal signatures fold into one sweep dispatch:
+        identical spec/cfg/options/sweep, constants equal OUTSIDE the
+        swept names (inside them is the batch axis)."""
+        fixed = {k: v for k, v in sorted(self.constants.items())
+                 if k not in self.sweep_params()}
+        blob = json.dumps(
+            [self.spec, self.cfg, sorted(self.options.items()),
+             sorted((self.sweep or {}).items()), fixed],
+            sort_keys=True,
+        )
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def summary(self) -> dict:
+        return dict(
+            id=self.id, name=self.name, state=self.state,
+            engine=self.engine, sweep=self.sweep,
+            constants=self.constants, options=self.options,
+            submitted_t=round(self.submitted_t, 3),
+            started_t=self.started_t and round(self.started_t, 3),
+            finished_t=self.finished_t and round(self.finished_t, 3),
+            result=self.result, error=self.error,
+            journal=f"{self.id}.journal.jsonl",
+        )
+
+
+def _module_name(spec_text: str) -> str:
+    for line in spec_text.splitlines():
+        s = line.strip()
+        if s.startswith("----") and "MODULE" in s:
+            return s.split("MODULE", 1)[1].strip().strip("- ").split()[0]
+    raise JobError("spec text has no ---- MODULE Name ---- header")
+
+
+def _result_dict(r, engine: str, pool_hit: bool = None) -> dict:
+    verdict = "ok" if r.violation == 0 else "violation"
+    out = dict(
+        verdict=verdict, generated=r.generated, distinct=r.distinct,
+        depth=r.depth, queue=r.queue_left, violation=r.violation,
+        violation_name=(None if r.violation == 0 else r.violation_name),
+        action_generated=r.action_generated,
+        action_distinct=r.action_distinct,
+        wall_s=round(r.wall_s, 6), engine=engine,
+    )
+    if pool_hit is not None:
+        out["pool_hit"] = pool_hit
+    return out
+
+
+class Scheduler:
+    """The FIFO worker: owns the queue, the job registry, the pool and
+    the per-job journals under `root`."""
+
+    def __init__(self, root: str, pool: Optional[EnginePool] = None,
+                 large_fpcap: int = DEFAULT_LARGE_FPCAP):
+        self.root = root
+        self.pool = pool or EnginePool()
+        self.large_fpcap = large_fpcap
+        self.jobs: "OrderedDict[str, Job]" = OrderedDict()
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self.batches_run = 0
+        self.batched_jobs = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, spec: str, cfg: str, **kw) -> Job:
+        job = Job(spec, cfg, **kw)
+        if job.sweep:
+            params = job.sweep_params()  # validates the descriptor
+            missing = [c for c in params if c not in job.constants]
+            if missing:
+                raise JobError(
+                    f"sweep job must pin its swept constants "
+                    f"{missing} in 'constants'"
+                )
+        _module_name(spec)  # validates the module header
+        with self._cond:
+            self.jobs[job.id] = job
+            self._queue.append(job.id)
+            self._cond.notify()
+        return job
+
+    def get(self, job_id: str) -> Optional[Job]:
+        with self._cond:
+            return self.jobs.get(job_id)
+
+    def list(self) -> List[dict]:
+        with self._cond:
+            return [j.summary() for j in self.jobs.values()]
+
+    def stats(self) -> dict:
+        with self._cond:
+            states: Dict[str, int] = {}
+            for j in self.jobs.values():
+                states[j.state] = states.get(j.state, 0) + 1
+            return dict(jobs=len(self.jobs), queued=len(self._queue),
+                        states=states, batches_run=self.batches_run,
+                        batched_jobs=self.batched_jobs,
+                        large_fpcap=self.large_fpcap)
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until every submitted job left the queue and finished
+        (tools/loadgen + tests); False on timeout."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._cond:
+                busy = self._queue or any(
+                    j.state in ("queued", "running")
+                    for j in self.jobs.values()
+                )
+            if not busy:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10)
+
+    # -- the worker --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait(0.5)
+                if self._stop:
+                    return
+                head = self.jobs[self._queue.popleft()]
+                batch = [head]
+                if head.sweep and not head.is_large(self.large_fpcap):
+                    # look ahead: fold queued jobs of the same class
+                    # into this dispatch (FIFO among the folded; the
+                    # skipped-over rest keeps its order)
+                    sig = head.batch_signature()
+                    width = self.pool.sweep_width
+                    keep = deque()
+                    while self._queue and len(batch) < width:
+                        cand = self.jobs[self._queue.popleft()]
+                        if cand.batch_signature() == sig:
+                            batch.append(cand)
+                        else:
+                            keep.append(cand.id)
+                    self._queue.extendleft(reversed(keep))
+                for j in batch:
+                    j.state = "running"
+                    j.started_t = time.time()
+            try:
+                self._run_batch(batch)
+            except Exception as e:  # a broken job must not kill the loop
+                for j in batch:
+                    if j.state == "running":
+                        self._finish_error(j, f"{type(e).__name__}: {e}")
+
+    # -- execution paths ---------------------------------------------------
+
+    def _jobdir(self, job: Job) -> str:
+        d = os.path.join(self.root, "jobs", job.id)
+        os.makedirs(d, exist_ok=True)
+        mod = _module_name(job.spec)
+        with open(os.path.join(d, f"{mod}.tla"), "w") as f:
+            f.write(job.spec)
+        with open(os.path.join(d, f"{mod}.cfg"), "w") as f:
+            f.write(job.cfg)
+        return os.path.join(d, f"{mod}.cfg")
+
+    def _journal(self, job: Job):
+        from ..obs.journal import RunJournal
+
+        return RunJournal(
+            os.path.join(self.root, f"{job.id}.journal.jsonl"),
+            fsync_every=JOB_FSYNC_EVERY,
+        )
+
+    def _run_batch(self, batch: List[Job]) -> None:
+        head = batch[0]
+        if head.sweep and not head.is_large(self.large_fpcap):
+            self._run_sweep(batch)
+            return
+        assert len(batch) == 1
+        if head.is_large(self.large_fpcap):
+            self._run_supervised(head)
+        else:
+            self._run_pooled(head)
+
+    def _geometry(self, job: Job) -> dict:
+        o = job.options
+        return dict(
+            chunk=int(o.get("chunk", 64)),
+            queue_capacity=int(o.get("qcap", 1 << 10)),
+            fp_capacity=int(o.get("fpcap", 1 << 12)),
+            check_deadlock=not o.get("nodeadlock", False),
+        )
+
+    def _run_sweep(self, batch: List[Job]) -> None:
+        """One vmapped dispatch for the whole compatible batch."""
+        import jax
+
+        from . import sweep as sw
+
+        head = batch[0]
+        params = head.sweep_params()
+        cfg_path = self._jobdir(head)
+        model = sw.load_anchored(cfg_path, params)
+        pre = self.pool.hits
+        entry = self.pool.get_sweep(model, params, **self._geometry(head))
+        hit = self.pool.hits > pre
+        configs = [
+            {c: int(j.constants[c]) for c in params} for j in batch
+        ]
+        device = str(jax.devices()[0])
+        journals = []
+        for j in batch:
+            if j is not head:
+                self._jobdir(j)  # each job keeps its own artifacts
+            jr = self._journal(j)
+            jr.event("run_start", version=_version(), workload=j.name,
+                     engine="sweep", device=device,
+                     params=dict(**self._geometry(j),
+                                 sweep=j.sweep, constants=j.constants,
+                                 batch=len(batch), pool_hit=hit))
+            journals.append(jr)
+        results = entry.runner.run(configs)
+        with self._cond:
+            self.batches_run += 1
+            self.batched_jobs += len(batch)
+        for j, jr, r in zip(batch, journals, results):
+            if r.violation != 0:
+                jr.event("violation", code=int(r.violation),
+                         name=r.violation_name)
+            jr.event("final",
+                     verdict="ok" if r.violation == 0 else "violation",
+                     generated=r.generated, distinct=r.distinct,
+                     depth=r.depth, queue=r.queue_left,
+                     wall_s=round(r.wall_s, 6), interrupted=False)
+            jr.close()
+            self._finish_ok(j, _result_dict(r, "sweep", pool_hit=hit))
+
+    def _run_pooled(self, job: Job) -> None:
+        """Warm plain engine via the pool; falls back to the supervised
+        path when the spec does not resolve structurally."""
+        import jax
+
+        from ..struct.loader import StructLoadError, load
+        from ..struct.parser import StructParseError
+
+        cfg_path = self._jobdir(job)
+        try:
+            model = load(cfg_path, const_overrides={
+                k: v for k, v in job.constants.items()
+            } or None)
+        except (StructLoadError, StructParseError, JobError):
+            self._run_supervised(job)
+            return
+        geo = self._geometry(job)
+        pre = self.pool.hits
+        entry = self.pool.get_single(model, **geo)
+        hit = self.pool.hits > pre
+        jr = self._journal(job)
+        jr.event("run_start", version=_version(), workload=job.name,
+                 engine="pool", device=str(jax.devices()[0]),
+                 params=dict(**geo, constants=job.constants,
+                             pool_hit=hit))
+        r = entry.runner.run()
+        if r.violation != 0:
+            jr.event("violation", code=int(r.violation),
+                     name=r.violation_name)
+        jr.event("final",
+                 verdict="ok" if r.violation == 0 else "violation",
+                 generated=r.generated, distinct=r.distinct,
+                 depth=r.depth, queue=r.queue_left,
+                 wall_s=round(r.wall_s, 6), interrupted=False)
+        jr.close()
+        self._finish_ok(job, _result_dict(r, "pool", pool_hit=hit))
+
+    def _run_supervised(self, job: Job) -> None:
+        """Large / resilience-option jobs: the full api.run_check
+        pipeline (resil supervisor, degradation ladder, preflight, TLC
+        transcript captured as the job's output)."""
+        from ..api import CheckRequest, run_check
+
+        cfg_path = self._jobdir(job)
+        out = io.StringIO()
+        kw = {k: job.options[k] for k in _REQUEST_OPTIONS
+              if k in job.options}
+        kw.setdefault("workers", "cpu" if _on_cpu() else "tpu")
+        req = CheckRequest(
+            config=cfg_path,
+            journal=os.path.join(self.root,
+                                 f"{job.id}.journal.jsonl"),
+            noTool=True, out=out, err=out, **kw,
+        )
+        outcome = run_check(req)
+        r = outcome.result
+        res = dict(verdict=outcome.verdict,
+                   exit_code=outcome.exit_code, engine="supervised",
+                   transcript=out.getvalue())
+        if r is not None:
+            res.update(
+                generated=r.generated, distinct=r.distinct,
+                depth=r.depth, queue=r.queue_left,
+                violation=r.violation,
+                action_generated=r.action_generated,
+                wall_s=round(r.wall_s, 6),
+            )
+        if outcome.exit_code in (0, 12, 13, 75):
+            self._finish_ok(job, res)
+        else:
+            job.result = res
+            self._finish_error(
+                job, f"exit {outcome.exit_code}: {out.getvalue()[-500:]}"
+            )
+
+    # -- completion --------------------------------------------------------
+
+    def _finish_ok(self, job: Job, result: dict) -> None:
+        with self._cond:
+            job.result = result
+            job.engine = result.get("engine", "")
+            job.state = "done"
+            job.finished_t = time.time()
+
+    def _finish_error(self, job: Job, msg: str) -> None:
+        with self._cond:
+            job.error = msg
+            job.state = "error"
+            job.finished_t = time.time()
+
+
+def _version() -> str:
+    from .. import __version__
+
+    return __version__
+
+
+def _on_cpu() -> bool:
+    import jax
+
+    return jax.devices()[0].platform == "cpu"

@@ -1,0 +1,152 @@
+"""Checking-as-a-service: the HTTP job API (stdlib only).
+
+The front door of ROADMAP #4: a long-lived process that accepts
+spec+cfg jobs, runs them through the FIFO scheduler (serve.scheduler)
+against the warm AOT engine pool (serve.pool), and serves results plus
+live telemetry.  The monitoring surface IS obs.serve - this handler
+subclasses it, so ``/runs``, ``/metrics``, ``/journal`` and the SSE
+``/events`` tail come from the same code the single-run ``-serve``
+monitor uses, reading the per-job journals the scheduler writes.  A
+job-scoped event stream is just ``/events?run=<job id>``.
+
+Endpoints (on top of the inherited monitor):
+
+* ``POST /jobs`` - submit a check.  JSON body::
+
+      {"name": "...", "spec": "---- MODULE M ----\\n...",
+       "cfg": "CONSTANT ...", "constants": {"N": 3},
+       "sweep": {"const": "N", "lo": 1, "hi": 4},
+       "options": {"chunk": 64, "qcap": 1024, "fpcap": 4096}}
+
+  -> 202 with the job id + the URLs to poll/stream.  Compatible sweep
+  jobs batch into one vmapped dispatch; large jobs route through the
+  resil supervisor (see serve.scheduler for the discipline).
+* ``GET /jobs`` - the job registry (state, engine, result per job).
+* ``GET /jobs/<id>`` - one job's record (the verdict lives here).
+* ``GET /pool`` - engine-pool + scheduler + compile-meter stats (the
+  warm/cold accounting ``tools/loadgen.py`` asserts on).
+
+``python -m jaxtlc.serve`` starts it; ``jaxtlc.serve.client`` is the
+thin submit/wait/stream client driving it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Optional
+
+from ..obs import serve as obs_serve
+from .pool import EnginePool
+from .scheduler import JobError, Scheduler
+
+
+class _JobHandler(obs_serve._Handler):
+    """The monitor handler + the job API.  `scheduler` is stamped
+    class-wide by CheckServer (same pattern as `root`)."""
+
+    scheduler: Scheduler = None
+
+    # -- job API -----------------------------------------------------------
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        parsed_path = self.path.rstrip("/")
+        if parsed_path != "/jobs":
+            self._send(404, b"unknown endpoint\n", "text/plain")
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n).decode() or "{}")
+            spec, cfg = body.get("spec"), body.get("cfg")
+            if not spec or not cfg:
+                raise JobError("body needs 'spec' and 'cfg' text")
+            job = self.scheduler.submit(
+                spec, cfg, name=body.get("name", ""),
+                constants=body.get("constants"),
+                sweep=body.get("sweep"),
+                options=body.get("options"),
+            )
+        except (JobError, ValueError) as e:
+            self._send(400, f"bad job: {e}\n".encode(), "text/plain")
+            return
+        self._send(202, json.dumps({
+            "id": job.id,
+            "job": f"/jobs/{job.id}",
+            "events": f"/events?run={job.id}",
+            "journal": f"/journal?run={job.id}",
+        }).encode(), "application/json")
+
+    def do_GET(self):  # noqa: N802
+        route = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if route == "/jobs":
+                self._send(200, json.dumps(
+                    {"jobs": self.scheduler.list()}
+                ).encode(), "application/json")
+            elif route.startswith("/jobs/"):
+                job = self.scheduler.get(route[len("/jobs/"):])
+                if job is None:
+                    self._send(404, b"no such job\n", "text/plain")
+                    return
+                self._send(200, json.dumps(job.summary()).encode(),
+                           "application/json")
+            elif route == "/pool":
+                self._send(200, json.dumps({
+                    "pool": self.scheduler.pool.stats(),
+                    "scheduler": self.scheduler.stats(),
+                }).encode(), "application/json")
+            else:
+                super().do_GET()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-write: their call
+
+
+class CheckServer:
+    """A running checking service: HTTP front + scheduler + pool over
+    one runs directory.  `port=0` binds ephemeral; read `.port`."""
+
+    def __init__(self, root: Optional[str] = None, port: int = 0,
+                 host: str = "127.0.0.1", pool: EnginePool = None,
+                 pool_capacity: int = 8, sweep_width: int = None,
+                 large_fpcap: int = None):
+        from http.server import ThreadingHTTPServer
+
+        from .scheduler import DEFAULT_LARGE_FPCAP
+
+        self.root = root or tempfile.mkdtemp(prefix="jaxtlc-serve-")
+        os.makedirs(self.root, exist_ok=True)
+        self.pool = pool or EnginePool(capacity=pool_capacity,
+                                       sweep_width=sweep_width)
+        self.scheduler = Scheduler(
+            self.root, pool=self.pool,
+            large_fpcap=large_fpcap or DEFAULT_LARGE_FPCAP,
+        )
+        handler = type("BoundJobHandler", (_JobHandler,),
+                       {"root": self.root, "scheduler": self.scheduler})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd._jaxtlc_shutdown = threading.Event()
+        self.httpd.daemon_threads = True
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self.scheduler.shutdown()
+        self.httpd._jaxtlc_shutdown.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def start_server(root: Optional[str] = None, port: int = 0,
+                 host: str = "127.0.0.1", **kw) -> CheckServer:
+    """Start the checking service; returns the running CheckServer."""
+    return CheckServer(root, port=port, host=host, **kw)

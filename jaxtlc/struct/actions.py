@@ -41,6 +41,19 @@ class ActionSystem:
         self.next_ast = ev.defs[next_name].body
         self._mentions_cache: Dict[int, bool] = {}
 
+    def with_constants(self, constants: Dict[str, object]) -> "ActionSystem":
+        """The same Init/Next under different CONSTANT values - the
+        constant-config sweep engine (jaxtlc.serve.sweep) enumerates
+        each configuration's Init set host-side through this, against
+        the one already-parsed module."""
+        clone = ActionSystem.__new__(ActionSystem)
+        clone.ev = Evaluator(self.ev.defs, dict(constants))
+        clone.variables = self.variables
+        clone.init_ast = self.init_ast
+        clone.next_ast = self.next_ast
+        clone._mentions_cache = {}
+        return clone
+
     # -- prime detection ---------------------------------------------------
 
     def _mentions_prime(self, ast) -> bool:

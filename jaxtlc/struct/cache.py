@@ -28,15 +28,88 @@ cost (minutes for Model_1-class modules).  Both are pure functions of
 from __future__ import annotations
 
 import os
-from typing import Dict, Tuple
+from collections import OrderedDict
+from typing import Tuple
 
 _DEFAULT_CACHE_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "jaxtlc", "xla"
 )
 
 _persistent_enabled: str = ""
-_BACKEND_MEMO: Dict[tuple, object] = {}
-_ENGINE_MEMO: Dict[tuple, tuple] = {}
+
+
+class _LRUMemo:
+    """Bounded in-process memo (ISSUE 9 satellite): a long-lived
+    serving process runs an unbounded stream of distinct models, so the
+    memo that used to be a plain dict now evicts least-recently-used
+    entries at a size cap and exposes hit/miss/size stats (the
+    serve-side EnginePool builds on these counters for its own
+    warm/cold accounting).  Eviction only drops OUR reference: callers
+    holding an evicted backend/engine keep it alive (and jax keeps its
+    compiled executable alive through their closures)."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._d: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key):
+        hit = self._d.get(key)
+        if hit is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._d.move_to_end(key)
+        return hit
+
+    def put(self, key, value) -> None:
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self.cap:
+            self._d.popitem(last=False)
+            self.evictions += 1
+
+    def stats(self) -> dict:
+        return dict(hits=self.hits, misses=self.misses,
+                    size=len(self._d), cap=self.cap,
+                    evictions=self.evictions)
+
+    def clear(self) -> None:
+        self._d.clear()
+
+
+def _env_cap(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, "")))
+    except ValueError:
+        return default
+
+
+# backends are cheap-ish Python (parse + shape-infer + closures); built
+# engines pin compiled-executable references, so their cap is tighter
+_BACKEND_MEMO = _LRUMemo(_env_cap("JAXTLC_BACKEND_MEMO_CAP", 64))
+_ENGINE_MEMO = _LRUMemo(_env_cap("JAXTLC_ENGINE_MEMO_CAP", 32))
+
+
+def stats() -> dict:
+    """Hit/miss/size/eviction counters for both memos (cumulative per
+    process; the serve /pool endpoint republishes them)."""
+    return {"backend": _BACKEND_MEMO.stats(),
+            "engine": _ENGINE_MEMO.stats()}
+
+
+def set_caps(backend: int = None, engine: int = None) -> None:
+    """Resize the memo caps (tests + server sizing; shrinking evicts
+    LRU entries immediately)."""
+    for memo, cap in ((_BACKEND_MEMO, backend), (_ENGINE_MEMO, engine)):
+        if cap is None:
+            continue
+        memo.cap = max(1, int(cap))
+        while len(memo._d) > memo.cap:
+            memo._d.popitem(last=False)
+            memo.evictions += 1
 
 
 def enable_persistent_cache(path: str = None) -> str:
@@ -88,8 +161,31 @@ def get_backend(model, check_deadlock: bool = True):
     hit = _BACKEND_MEMO.get(key)
     if hit is None:
         hit = struct_backend(model, check_deadlock=check_deadlock)
-        _BACKEND_MEMO[key] = hit
+        _BACKEND_MEMO.put(key, hit)
     return hit
+
+
+def engine_key(
+    model,
+    chunk: int,
+    queue_capacity: int,
+    fp_capacity: int,
+    fp_index: int,
+    seed: int,
+    fp_highwater: float,
+    check_deadlock: bool = True,
+    pipeline: bool = False,
+    obs_slots: int = 0,
+) -> tuple:
+    """The full engine-memo key: spec meaning (digest + canonical
+    constants + invariants) x engine geometry x pipeline/obs flags.
+    The serve EnginePool keys its warm AOT entries on exactly this
+    tuple so pool identity and memo identity cannot drift."""
+    return (
+        model_key(model), "single", chunk, queue_capacity, fp_capacity,
+        fp_index, seed, fp_highwater, bool(check_deadlock),
+        bool(pipeline), int(obs_slots),
+    )
 
 
 def get_engine(
@@ -112,10 +208,10 @@ def get_engine(
     from ..engine.bfs import make_backend_engine
 
     enable_persistent_cache()
-    key = (
-        model_key(model), "single", chunk, queue_capacity, fp_capacity,
-        fp_index, seed, fp_highwater, bool(check_deadlock),
-        bool(pipeline), int(obs_slots),
+    key = engine_key(
+        model, chunk, queue_capacity, fp_capacity, fp_index, seed,
+        fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
+        obs_slots=obs_slots,
     )
     hit = _ENGINE_MEMO.get(key)
     if hit is None:
@@ -125,7 +221,7 @@ def get_engine(
             fp_highwater=fp_highwater, pipeline=pipeline,
             obs_slots=obs_slots,
         )
-        _ENGINE_MEMO[key] = hit
+        _ENGINE_MEMO.put(key, hit)
     return hit
 
 

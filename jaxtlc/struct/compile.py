@@ -157,11 +157,19 @@ def _binop_arrs(a_arr, a_d, b_arr, b_d):
 
 class LaneCompiler:
     def __init__(self, ev: Evaluator, variables: Tuple[str, ...],
-                 var_shapes: Dict[str, Shape], codec: StructCodec):
+                 var_shapes: Dict[str, Shape], codec: StructCodec,
+                 sweep_vars: frozenset = frozenset()):
         self.ev = ev
         self.variables = variables
         self.var_shapes = var_shapes
         self.codec = codec
+        # swept constants (jaxtlc.serve.sweep): CONSTANT names promoted
+        # to read-only codec fields so their value is RUNTIME data - one
+        # compiled step serves every configuration of the constants
+        # class.  decode_state hands them to expressions like any state
+        # variable (env wins over ev.constants in _comp_name); the spec
+        # never primes them, so build_step passes them through verbatim
+        self.sweep_vars = frozenset(sweep_vars)
         self._field_tables: Dict = {}
         self._trans_tables: Dict = {}
         self._pred_tables: Dict = {}
@@ -1870,6 +1878,9 @@ class LaneCompiler:
                 cols = []
                 for v, lay in zip(self.variables, self.codec.layouts):
                     lv = lane.env.get(("'", v))
+                    if lv is None and v in self.sweep_vars:
+                        # a swept constant is unchanged by construction
+                        lv = "passthrough"
                     if lv is None:
                         raise CompileError(
                             f"lane {lane.label}: {v}' unassigned"

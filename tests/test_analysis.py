@@ -542,7 +542,7 @@ def test_selfcheck_registry_pinned():
 
     assert sorted(FACTORIES) == [
         "enumerator", "fused", "phased", "pipelined", "sharded",
-        "spill", "struct",
+        "spill", "struct", "sweep",
     ]
 
 

@@ -1,0 +1,400 @@
+"""Checking-as-a-service tests (ISSUE 9).
+
+Budget discipline (tier-1 runs ~800 s of its 870 s ceiling): ONE
+module-scoped CheckServer owns the only sweep-class compile; the
+bit-for-bit parity test reuses that warm engine (shared fixture, no
+extra engine compiles beyond the one sweep compile + its sequential
+AOT twin), and the independent baked-constant baseline runs the same
+TwoPhaseB geometry so the struct-cache memo shares what it can.
+
+Pinned here:
+
+* server e2e: POST /jobs -> FIFO schedule -> sweep batch -> job-scoped
+  SSE stream -> verdict -> /runs registry (the acceptance flow);
+* warm resubmit of an already-compiled (digest, constants-class,
+  geometry) performs ZERO fresh XLA compiles (CompileMeter delta == 0);
+* vmapped K-config sweep verdicts/counters bit-for-bit against K
+  sequential runs of the same compiled step - final carries compared
+  leaf-by-leaf, fpset TABLE words included - and counter-equal to K
+  independent `api.run_check` calls on baked-constant TwoPhase
+  variants;
+* struct.cache LRU cap + hit/miss stats; EnginePool LRU eviction;
+* obs.journal batched-fsync mode semantics.
+"""
+
+import io
+import json
+import os
+
+import pytest
+
+from jaxtlc.serve import client
+from jaxtlc.serve.server import start_server
+
+_TPB = """---- MODULE TwoPhaseB ----
+EXTENDS Naturals, FiniteSets, TLC
+
+CONSTANTS RM, MAXR
+
+VARIABLES rmState, tmState, tmPrepared, msgs, reneged
+
+vars == <<rmState, tmState, tmPrepared, msgs, reneged>>
+
+Init == /\\ rmState = [r \\in RM |-> "working"]
+        /\\ tmState = "running"
+        /\\ tmPrepared = {}
+        /\\ msgs = {}
+        /\\ reneged = 0
+
+Vote(r) == /\\ rmState[r] = "working"
+           /\\ rmState' = [rmState EXCEPT ![r] = "prepared"]
+           /\\ msgs' = msgs \\cup {[kind |-> "vote", from |-> r]}
+           /\\ UNCHANGED <<tmState, tmPrepared, reneged>>
+
+Renege(r) == /\\ rmState[r] = "working"
+             /\\ reneged < MAXR
+             /\\ reneged' = reneged + 1
+             /\\ rmState' = [rmState EXCEPT ![r] = "aborted"]
+             /\\ UNCHANGED <<tmState, tmPrepared, msgs>>
+
+Collect(r) == /\\ tmState = "running"
+              /\\ [kind |-> "vote", from |-> r] \\in msgs
+              /\\ tmPrepared' = tmPrepared \\cup {r}
+              /\\ UNCHANGED <<rmState, tmState, msgs, reneged>>
+
+Decide == /\\ tmState = "running"
+          /\\ tmPrepared = RM
+          /\\ tmState' = "committed"
+          /\\ msgs' = msgs \\cup {[kind |-> "commit"]}
+          /\\ UNCHANGED <<rmState, tmPrepared, reneged>>
+
+CallOff == /\\ tmState = "running"
+           /\\ tmState' = "aborted"
+           /\\ msgs' = msgs \\cup {[kind |-> "stop"]}
+           /\\ UNCHANGED <<rmState, tmPrepared, reneged>>
+
+ObeyCommit(r) == /\\ [kind |-> "commit"] \\in msgs
+                 /\\ rmState[r] = "prepared"
+                 /\\ rmState' = [rmState EXCEPT ![r] = "committed"]
+                 /\\ UNCHANGED <<tmState, tmPrepared, msgs, reneged>>
+
+ObeyAbort(r) == /\\ [kind |-> "stop"] \\in msgs
+                /\\ rmState[r] # "committed"
+                /\\ rmState[r] # "aborted"
+                /\\ rmState' = [rmState EXCEPT ![r] = "aborted"]
+                /\\ UNCHANGED <<tmState, tmPrepared, msgs, reneged>>
+
+Next == \\/ Decide
+        \\/ CallOff
+        \\/ \\E r \\in RM : \\/ Vote(r)
+                         \\/ Renege(r)
+                         \\/ Collect(r)
+                         \\/ ObeyCommit(r)
+                         \\/ ObeyAbort(r)
+
+Spec == /\\ Init
+        /\\ [][Next]_vars
+
+Agreement == \\A r1, r2 \\in RM : ~(/\\ rmState[r1] = "aborted"
+                                  /\\ rmState[r2] = "committed")
+
+CommitVoted == tmState = "committed" => tmPrepared = RM
+====
+"""
+
+
+def _cfg(maxr: int) -> str:
+    return (f"CONSTANT RM = {{r1, r2}}\nCONSTANT MAXR = {maxr}\n"
+            "SPECIFICATION\nSpec\nINVARIANT\nAgreement\nCommitVoted\n")
+
+
+_SWEEP = {"const": "MAXR", "lo": 0, "hi": 2}
+_OPTS = dict(chunk=64, qcap=1 << 10, fpcap=1 << 12, nodeadlock=True)
+# (generated, distinct, depth, Renege fires) per MAXR - the bounded
+# 2PC family genuinely differs per config (MAXR=0 disables Renege)
+_EXPECT = {0: (81, 49, 8, 0), 1: (119, 66, 8, 18), 2: (124, 68, 8, 22)}
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = start_server(sweep_width=3)
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def sweep_jobs(server):
+    """Three compatible sweep submits - the scheduler folds them into
+    batched dispatches through ONE constants-class compile."""
+    ids = {
+        v: client.submit(server.url, _TPB, _cfg(2), name=f"tpb-max{v}",
+                         constants={"MAXR": v}, sweep=_SWEEP,
+                         options=_OPTS)
+        for v in (0, 1, 2)
+    }
+    return {v: client.wait(server.url, i, timeout=600)
+            for v, i in ids.items()}
+
+
+# ---------------------------------------------------------------------------
+# server e2e: submit -> schedule -> sweep -> SSE -> verdict -> registry
+# ---------------------------------------------------------------------------
+
+
+def test_server_sweep_e2e(server, sweep_jobs):
+    for v, st in sweep_jobs.items():
+        assert st["state"] == "done", st
+        r = st["result"]
+        gen, dist, depth, renege = _EXPECT[v]
+        assert r["engine"] == "sweep"
+        assert r["verdict"] == "ok"
+        assert (r["generated"], r["distinct"], r["depth"]) == \
+            (gen, dist, depth)
+        assert r["action_generated"].get("Renege", 0) == renege
+    stats = client.pool_stats(server.url)
+    # one constants-class entry served all three configs
+    assert stats["pool"]["misses"] >= 1
+    assert stats["scheduler"]["batched_jobs"] == 3
+    assert stats["scheduler"]["batches_run"] < 3  # folding happened
+
+
+def test_job_scoped_sse_stream_and_registry(server, sweep_jobs):
+    """/events?run=<job id> is the job's own SSE feed (the obs.serve
+    machinery over the scheduler's per-job journal); /runs lists every
+    job journal."""
+    job_id = sweep_jobs[1]["id"]
+    events = list(client.stream(server.url, job_id))
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "final"
+    assert events[0]["engine"] == "sweep"
+    assert events[-1]["verdict"] == "ok"
+    assert events[-1]["distinct"] == _EXPECT[1][1]
+    runs = client._get(server.url + "/runs")["runs"]
+    names = {r["run"] for r in runs}
+    assert {st["id"] for st in sweep_jobs.values()} <= names
+    by = {r["run"]: r for r in runs}
+    assert by[job_id]["verdict"] == "ok"
+
+
+def test_server_rejects_malformed_jobs(server):
+    import urllib.error
+    import urllib.request
+
+    def post(payload):
+        req = urllib.request.Request(
+            server.url + "/jobs", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        return urllib.request.urlopen(req, timeout=10)
+
+    for bad in (
+        {},  # no spec/cfg
+        {"spec": "not a module", "cfg": _cfg(1)},  # no MODULE header
+        # sweep job without its swept constant pinned
+        {"spec": _TPB, "cfg": _cfg(1), "sweep": _SWEEP},
+    ):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(bad)
+        assert e.value.code == 400
+
+
+# ---------------------------------------------------------------------------
+# warm-path contract: zero fresh XLA compiles (the acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+def test_warm_resubmit_zero_fresh_xla_compiles(server, sweep_jobs):
+    """Resubmitting an already-compiled (digest, constants-class,
+    geometry) must be pure warm execution: pool hit, CompileMeter
+    delta exactly zero.  Covers BOTH pool paths - the plain engine and
+    the batched sweep."""
+    from jaxtlc.serve.pool import xla_compiles
+
+    # plain engine: first submit builds (cold), second is warm
+    cold = client.check(server.url, _TPB, _cfg(2), name="plain-cold",
+                        options=_OPTS)
+    assert cold["result"]["engine"] == "pool"
+    assert cold["result"]["verdict"] == "ok"
+    pre = xla_compiles()
+    warm = client.check(server.url, _TPB, _cfg(2), name="plain-warm",
+                        options=_OPTS)
+    assert warm["result"]["pool_hit"] is True
+    assert xla_compiles() - pre == 0, "warm plain submit recompiled"
+    assert warm["result"]["generated"] == cold["result"]["generated"]
+
+    # sweep engine: the class is warm from the fixture batch
+    pre = xla_compiles()
+    st = client.check(server.url, _TPB, _cfg(2), name="sweep-warm",
+                      constants={"MAXR": 1}, sweep=_SWEEP,
+                      options=_OPTS)
+    assert st["result"]["pool_hit"] is True
+    assert xla_compiles() - pre == 0, "warm sweep submit recompiled"
+    assert st["result"]["distinct"] == _EXPECT[1][1]
+
+
+# ---------------------------------------------------------------------------
+# sweep parity: vmapped == sequential, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def _sweep_engine(server):
+    entries = [e for e in server.pool._entries.values()
+               if e.kind == "sweep"]
+    assert len(entries) == 1, "expected exactly one sweep-class entry"
+    return entries[0].runner
+
+
+def test_sweep_parity_bit_for_bit(server, sweep_jobs):
+    """The vmapped batch and K sequential runs of the SAME compiled
+    step agree on the full final carry - every pytree leaf, fpset
+    TABLE words included (vmap's batched while_loop freezes each lane
+    at its own fixpoint; this pins that nothing leaks across lanes)."""
+    import jax
+    import numpy as np
+
+    eng = _sweep_engine(server)
+    configs = [{"MAXR": v} for v in (0, 1, 2)]
+    batch = eng.run(configs)
+    seq = eng.run_sequential(configs)
+    for b, s in zip(batch, seq):
+        assert (b.generated, b.distinct, b.depth, b.violation,
+                b.queue_left, b.outdegree) == \
+            (s.generated, s.distinct, s.depth, s.violation,
+             s.queue_left, s.outdegree)
+        assert b.action_generated == s.action_generated
+        assert b.action_distinct == s.action_distinct
+    # leaf-level: stacked batch carry row k == config k's solo carry
+    stacked_out = eng._aot(eng._stack(configs))
+    for k, values in enumerate(configs):
+        solo_out = eng._aot_seq(eng.carry_for(values))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(
+                jax.tree.map(lambda x: x[k], stacked_out)),
+            jax.tree_util.tree_leaves(solo_out),
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sweep_matches_baked_constant_run_check(tmp_path, sweep_jobs):
+    """Independent baseline: K `api.run_check` calls on TwoPhaseB
+    variants with MAXR BAKED into the cfg (the pre-sweep path - its
+    own compiled step per config) report the same verdict and the same
+    generated/distinct/depth/per-action counters as the sweep lanes.
+    The swept-field encoding changes fingerprints, never counts: the
+    per-config state graphs are isomorphic."""
+    from jaxtlc.api import CheckRequest, run_check
+
+    for v, (gen, dist, depth, renege) in _EXPECT.items():
+        d = tmp_path / f"V{v}"
+        d.mkdir()
+        (d / "TwoPhaseB.tla").write_text(_TPB)
+        (d / "TwoPhaseB.cfg").write_text(_cfg(v))
+        out = io.StringIO()
+        oc = run_check(CheckRequest(
+            config=str(d / "TwoPhaseB.cfg"), workers="cpu",
+            frontend="struct", chunk=64, qcap=1 << 10, fpcap=1 << 12,
+            nodeadlock=True, obs=False, autogrow=False, noTool=True,
+            out=out,
+        ))
+        assert oc.exit_code == 0 and oc.verdict == "ok"
+        r = oc.result
+        assert (r.generated, r.distinct, r.depth) == (gen, dist, depth)
+        assert r.action_generated.get("Renege", 0) == renege
+        sl = sweep_jobs[v]["result"]
+        assert (sl["generated"], sl["distinct"], sl["depth"]) == \
+            (r.generated, r.distinct, r.depth)
+        assert sl["action_generated"] == {
+            k: int(n) for k, n in r.action_generated.items()
+        }
+        # the library surface: transcript captured, not printed
+        assert "TwoPhaseB" in out.getvalue()
+        assert "states generated" in out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# satellites: memo cap + stats, pool LRU, batched fsync
+# ---------------------------------------------------------------------------
+
+
+def test_struct_cache_lru_cap_and_stats():
+    from jaxtlc.struct.cache import _LRUMemo, stats
+
+    m = _LRUMemo(2)
+    assert m.get("a") is None  # miss
+    m.put("a", 1)
+    m.put("b", 2)
+    assert m.get("a") == 1  # hit; "a" becomes MRU
+    m.put("c", 3)  # evicts "b" (LRU)
+    assert m.get("b") is None
+    assert m.get("a") == 1 and m.get("c") == 3
+    s = m.stats()
+    assert (s["hits"], s["misses"], s["size"], s["evictions"]) == \
+        (3, 2, 2, 1)
+    top = stats()
+    for memo in ("backend", "engine"):
+        for k in ("hits", "misses", "size", "cap", "evictions"):
+            assert k in top[memo]
+        assert top[memo]["cap"] >= 1
+
+
+def test_engine_pool_lru_eviction_and_stats():
+    from jaxtlc.serve.pool import EnginePool
+
+    pool = EnginePool(capacity=2)
+    built = []
+
+    def make(tag):
+        def build():
+            built.append(tag)
+            return tag
+        return build
+
+    for tag in ("a", "b"):
+        pool._get_or_build((tag,), make(tag), "single", {})
+    assert pool._get_or_build(("a",), make("a2"), "single", {}).runner \
+        == "a"  # hit, no rebuild
+    pool._get_or_build(("c",), make("c"), "single", {})  # evicts "b"
+    assert built == ["a", "b", "c"]
+    pool._get_or_build(("b",), make("b2"), "single", {})  # miss again
+    s = pool.stats()
+    assert (s["hits"], s["misses"], s["evictions"], s["size"]) == \
+        (1, 4, 2, 2)
+    assert s["compiles"] == 4
+    assert "xla_compiles" in s and "memo" in s
+
+
+def test_journal_batched_fsync(tmp_path, monkeypatch):
+    """fsync_every=N: every event still lands as a complete flushed
+    line (the reader sees it immediately); the fsync barrier fires once
+    per N events and on close/sync."""
+    from jaxtlc.obs import journal as jr
+
+    syncs = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: (syncs.append(fd), real_fsync(fd)))
+    path = str(tmp_path / "batched.journal.jsonl")
+    j = jr.RunJournal(path, fsync_every=3)
+    for d in (1, 2):
+        j.event("progress", depth=d, generated=d, distinct=d, queue=0)
+    assert syncs == []  # below the batch threshold: no barrier yet
+    assert len(jr.read(path, validate=False)) == 2  # but lines landed
+    j.event("progress", depth=3, generated=3, distinct=3, queue=0)
+    assert len(syncs) == 1  # third event hit the threshold
+    j.event("progress", depth=4, generated=4, distinct=4, queue=0)
+    j.sync()
+    assert len(syncs) == 2  # explicit barrier flushes the remainder
+    j.sync()
+    assert len(syncs) == 2  # idempotent when nothing is pending
+    j.event("progress", depth=5, generated=5, distinct=5, queue=0)
+    j.close()
+    assert len(syncs) == 3  # close never leaves unsynced lines
+    events = jr.read(path)
+    assert [e["depth"] for e in events] == [1, 2, 3, 4, 5]
+
+    # default remains per-event fsync (checkpointed-run durability)
+    syncs.clear()
+    with jr.RunJournal(str(tmp_path / "d.journal.jsonl")) as j2:
+        j2.event("progress", depth=1, generated=1, distinct=1, queue=0)
+        j2.event("progress", depth=2, generated=2, distinct=2, queue=0)
+    assert len(syncs) == 2

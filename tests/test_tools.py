@@ -111,6 +111,23 @@ def test_committed_costmodel_document():
     assert "| chunk |" in mod.perf_table(doc)
 
 
+def test_loadgen_tiny_smoke(capsys):
+    """tools/loadgen.py --tiny: start a real checking service, submit
+    4 plain + 4 sweep jobs through the HTTP surface, assert pool reuse
+    and ZERO fresh XLA compiles on the warm path, and report the
+    p50/p95 warm latency (ISSUE 9 CI wiring; spec is tiny - one small
+    engine + one sweep-class compile total)."""
+    mod = _load_tool("loadgen")
+    assert mod.main(["--tiny"]) == 0
+    out = capsys.readouterr().out
+    assert "loadgen OK" in out, out
+    report = json.loads(out[: out.index("loadgen OK")])
+    assert report["warm_fresh_xla_compiles"] == 0
+    assert report["pool"]["hits"] >= report["jobs"] - 1
+    assert report["warm_p50_s"] <= report["warm_p95_s"]
+    assert report["scheduler"]["batched_jobs"] == report["sweep_jobs"]
+
+
 def test_trace_exporter_tiny_smoke(capsys):
     """The Chrome-trace exporter's --tiny: synthesize a journal, export
     it, and assert the expand/commit lanes landed in the JSON."""

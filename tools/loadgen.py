@@ -1,0 +1,178 @@
+"""Load generator for the checking service (ISSUE 9 CI tooling).
+
+Submits N jobs against a live `jaxtlc.serve` server (or an in-process
+one it starts itself), asserts the pool-reuse contract - every submit
+after the first of a (spec, constants-class, geometry) is a pool HIT
+and the warm path performs ZERO fresh XLA compiles - and reports
+latency percentiles for the warm path plus the batched-sweep
+throughput ratio.
+
+    python tools/loadgen.py --url http://HOST:PORT --jobs 32
+    python tools/loadgen.py --tiny     # self-contained; wired into
+                                       # tier-1 next to the serve and
+                                       # costmodel tiny smokes
+
+The tiny mode is the serving analog of `tools/chaos.py --matrix`: one
+driver invocation that exercises submit -> schedule -> pool ->
+sweep-batch -> journal -> /runs end to end and fails loudly if the
+warm path regresses into recompiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, _REPO)
+
+_SPEC = """---- MODULE LoadTiny ----
+EXTENDS Naturals
+CONSTANTS MAX
+VARIABLES x, y
+
+Init == /\\ x = 0
+        /\\ y = 0
+
+Up == /\\ x < MAX
+      /\\ x' = x + 1
+      /\\ y' = y
+
+Flip == /\\ x > 0
+        /\\ y' = 1 - y
+        /\\ x' = x
+
+Next == Up \\/ Flip
+
+Spec == Init /\\ [][Next]_<<x, y>>
+
+InRange == x <= MAX
+====
+"""
+
+_CFG = """CONSTANT MAX = 4
+SPECIFICATION
+Spec
+INVARIANT
+InRange
+"""
+
+
+def _pct(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    k = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return xs[k]
+
+
+def run_load(url: str, jobs: int, sweep_jobs: int,
+             out=sys.stdout) -> dict:
+    """Drive `url`: one cold submit, `jobs - 1` warm resubmits, then
+    `sweep_jobs` batched sweep submits.  Returns the report dict."""
+    from jaxtlc.serve import client
+    from jaxtlc.serve.pool import xla_compiles
+
+    opts = dict(chunk=16, qcap=256, fpcap=1024)
+    t0 = time.time()
+    cold = client.check(url, _SPEC, _CFG, name="load-cold",
+                        options=opts)
+    cold_s = time.time() - t0
+    assert cold["state"] == "done", cold
+    assert cold["result"]["verdict"] == "ok", cold
+
+    warm_lat = []
+    pre_compiles = xla_compiles()
+    for i in range(max(0, jobs - 1)):
+        t0 = time.time()
+        st = client.check(url, _SPEC, _CFG, name=f"load-warm-{i}",
+                          options=opts)
+        warm_lat.append(time.time() - t0)
+        assert st["state"] == "done", st
+        assert st["result"]["pool_hit"] is True, st
+        assert st["result"]["generated"] == cold["result"]["generated"]
+    fresh = xla_compiles() - pre_compiles
+    assert fresh == 0, f"warm path paid {fresh} fresh XLA compiles"
+
+    # batched sweep: K configs of the class through one dispatch
+    sweep = {"const": "MAX", "lo": 1, "hi": 4}
+    ids = [
+        client.submit(url, _SPEC, _CFG, name=f"load-sweep-{v}",
+                      constants={"MAX": 1 + (v % 4)}, sweep=sweep,
+                      options=opts)
+        for v in range(sweep_jobs)
+    ]
+    t0 = time.time()
+    sts = [client.wait(url, i, timeout=600) for i in ids]
+    sweep_s = time.time() - t0
+    for st in sts:
+        assert st["state"] == "done", st
+        assert st["result"]["engine"] == "sweep", st
+
+    stats = client.pool_stats(url)
+    report = dict(
+        jobs=jobs, sweep_jobs=sweep_jobs,
+        cold_s=round(cold_s, 4),
+        warm_p50_s=round(_pct(warm_lat, 0.50), 4),
+        warm_p95_s=round(_pct(warm_lat, 0.95), 4),
+        warm_fresh_xla_compiles=fresh,
+        sweep_wall_s=round(sweep_s, 4),
+        pool=dict(hits=stats["pool"]["hits"],
+                  misses=stats["pool"]["misses"],
+                  size=stats["pool"]["size"],
+                  compiles=stats["pool"]["compiles"]),
+        scheduler=dict(
+            batches_run=stats["scheduler"]["batches_run"],
+            batched_jobs=stats["scheduler"]["batched_jobs"],
+        ),
+    )
+    out.write(json.dumps(report, indent=2, sort_keys=True) + "\n")
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="loadgen")
+    p.add_argument("--url", default="",
+                   help="a live jaxtlc.serve server; default: start "
+                        "one in-process")
+    p.add_argument("--jobs", type=int, default=8,
+                   help="plain submits of one model (1 cold + N-1 warm)")
+    p.add_argument("--sweep-jobs", type=int, default=4,
+                   help="sweep submits folded into batched dispatches")
+    p.add_argument("--tiny", action="store_true",
+                   help="tier-1 smoke: in-process server, 4 plain + 4 "
+                        "sweep jobs, pool-reuse + zero-compile "
+                        "assertions")
+    args = p.parse_args(argv)
+    if args.tiny:
+        args.jobs, args.sweep_jobs, args.url = 4, 4, ""
+
+    srv = None
+    url = args.url
+    if not url:
+        from jaxtlc.serve.server import start_server
+
+        srv = start_server(sweep_width=4)
+        url = srv.url
+    try:
+        report = run_load(url, args.jobs, args.sweep_jobs)
+    finally:
+        if srv is not None:
+            srv.shutdown()
+    ok = (report["warm_fresh_xla_compiles"] == 0
+          and report["pool"]["hits"] >= args.jobs - 1)
+    print(f"loadgen {'OK' if ok else 'FAILED'}: "
+          f"{args.jobs} plain + {args.sweep_jobs} sweep jobs, "
+          f"warm p50 {report['warm_p50_s'] * 1000:.1f} ms / "
+          f"p95 {report['warm_p95_s'] * 1000:.1f} ms, "
+          f"0 fresh compiles on the warm path, "
+          f"{report['scheduler']['batched_jobs']} jobs through "
+          f"{report['scheduler']['batches_run']} sweep dispatches")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
