@@ -79,6 +79,10 @@ class CheckRequest:
     traceExpressions: str = ""
     mutation: str = ""
     # -- library-only knobs (no CLI flag) -------------------------------
+    # MC.cfg-style constant overrides applied on top of the config's
+    # baked values (the serve path: a job's constants must shape the
+    # checked configuration on EVERY route, supervised included)
+    constants: dict = dataclasses.field(default_factory=dict)
     # transcript / error sinks; None = the process stdout / stderr (the
     # CLI path - pinned transcripts depend on it)
     out: Optional[TextIO] = dataclasses.field(
@@ -148,6 +152,7 @@ def _run_check(args) -> int:
             fp_index=args.fp,
             check_deadlock=not args.nodeadlock,
             frontend=args.frontend,
+            const_overrides=getattr(args, "constants", None) or None,
         )
     except (ValueError, OSError) as e:
         print(f"Error: {e}", file=_err(args))

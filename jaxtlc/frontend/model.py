@@ -85,13 +85,19 @@ def resolve(
     fp_index: Optional[int] = None,
     check_deadlock: bool = True,
     frontend: str = "auto",
+    const_overrides: Optional[dict] = None,
 ) -> RunSpec:
     """Resolve a run from an MC.cfg (with sibling MC.tla) like TLC would.
 
     frontend: "auto" picks the hand-tuned KubeAPI path for the KubeAPI
     root spec, the gen-subset compiler for subset specs, and falls back
     to the structural frontend for anything else; "hand"/"gen"/"struct"
-    force a path (struct runs ANY spec, KubeAPI included)."""
+    force a path (struct runs ANY spec, KubeAPI included).
+
+    const_overrides: already-evaluated CONSTANT values layered on top
+    of the cfg's (the serve tier's per-job overrides); they win over
+    both the cfg assignments and the MC.tla substitutions, on every
+    frontend path."""
     if frontend not in ("auto", "hand", "gen", "struct"):
         raise ValueError(f"unknown -frontend {frontend!r}")
     cfg: TLCConfig = parse_cfg_file(cfg_path)
@@ -105,6 +111,8 @@ def resolve(
         for name, defname in cfg.substitutions.items():
             if defname in mc.definitions:
                 consts[name] = mc.definitions[defname]
+    if const_overrides:
+        consts.update(const_overrides)
 
     launch: Optional[LaunchConfig] = None
     if launch_path is None:
@@ -154,7 +162,7 @@ def resolve(
         # resolves through EXTENDS rather than a sibling file
         return _resolve_struct(cfg_path, cfg, launch, spec_name,
                                check_deadlock, workers, fp_index,
-                               model_dir)
+                               model_dir, const_overrides)
     if frontend == "hand" and spec_name not in ("", "KubeAPI"):
         raise ValueError(
             f"-frontend hand supports only the KubeAPI root spec, "
@@ -184,7 +192,7 @@ def resolve(
                 )
             return _resolve_struct(cfg_path, cfg, launch, spec_name,
                                    check_deadlock, workers, fp_index,
-                                   model_dir)
+                                   model_dir, const_overrides)
         if launch:
             # launch-file knobs apply to generic specs exactly as to the
             # KubeAPI path (deadlock switch, fpIndex)
@@ -246,12 +254,13 @@ def resolve(
 
 
 def _resolve_struct(cfg_path, cfg, launch, spec_name, check_deadlock,
-                    workers, fp_index, model_dir) -> StructRunSpec:
+                    workers, fp_index, model_dir,
+                    const_overrides=None) -> StructRunSpec:
     from ..struct.loader import StructLoadError, load as load_struct
     from ..struct.parser import StructParseError
 
     try:
-        sm = load_struct(cfg_path)
+        sm = load_struct(cfg_path, const_overrides=const_overrides)
     except (StructLoadError, StructParseError) as e:
         raise ValueError(
             f"root spec {spec_name!r}: structural frontend cannot load "

@@ -633,10 +633,13 @@ class ModuleParser:
         )
 
 
-def load_genspec(tla_path: str, cfg_constants: Dict[str, str],
+def load_genspec(tla_path: str, cfg_constants: Dict[str, object],
                  invariants: List[str], properties: List[str]) -> GenSpec:
-    """Parse a .tla module with MC.cfg-style constant strings."""
-    consts = {k: _const_value(v) for k, v in cfg_constants.items()}
+    """Parse a .tla module with MC.cfg-style constant values: strings
+    are interpreted as cfg literals; anything else (a resolve-level
+    const override, say) is already evaluated and passes through."""
+    consts = {k: _const_value(v) if isinstance(v, str) else v
+              for k, v in cfg_constants.items()}
     with open(tla_path, "r", encoding="utf-8") as f:
         text = f.read()
     try:

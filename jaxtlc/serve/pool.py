@@ -38,15 +38,22 @@ class CompileMeter:
     once per real XLA compile (AOT .compile() included, persistent-
     cache hits included: deserialization still passes through the
     event), never by a warm executable call.  Monotonic; assert on
-    deltas."""
+    deltas.
+
+    Registration prefers the public ``jax.monitoring`` module (the
+    private ``jax._src`` spelling is a fallback for old jaxes) and a
+    jax that exposes neither degrades the meter to ``available=False``
+    - the count stays zero and pool construction/server start proceed;
+    only the zero-compile ASSERTION loses its ground truth, and
+    callers can see that in `/pool`'s ``xla_meter`` field."""
 
     _instance: Optional["CompileMeter"] = None
 
     def __init__(self):
         self.count = 0
         self.wall_s = 0.0
+        self.available = False
         self._lock = threading.Lock()
-        from jax._src import monitoring
 
         def on_event(name, duration, **kw):
             if name.endswith("backend_compile_duration"):
@@ -54,7 +61,15 @@ class CompileMeter:
                     self.count += 1
                     self.wall_s += float(duration)
 
-        monitoring.register_event_duration_secs_listener(on_event)
+        try:
+            try:
+                from jax import monitoring
+            except ImportError:  # pragma: no cover - pre-public-API jax
+                from jax._src import monitoring
+            monitoring.register_event_duration_secs_listener(on_event)
+            self.available = True
+        except Exception:  # pragma: no cover - a metric, not a fault line
+            pass
 
     @classmethod
     def instance(cls) -> "CompileMeter":
@@ -264,6 +279,7 @@ class EnginePool:
                 compile_wall_s=round(self.compile_wall_s, 6),
                 xla_compiles=meter.count,
                 xla_compile_wall_s=round(meter.wall_s, 6),
+                xla_meter="ok" if meter.available else "unavailable",
                 sweep_width=self.sweep_width,
                 memo=struct_cache.stats(),
                 entries=entries,

@@ -87,6 +87,8 @@ class Job:
         c = self.sweep.get("const")
         if not c:
             raise JobError("sweep descriptor needs a 'const' name")
+        if self.sweep.get("hi") is None:
+            raise JobError("sweep descriptor needs a 'hi' domain bound")
         lo, hi = int(self.sweep.get("lo", 0)), int(self.sweep["hi"])
         return {c: (lo, hi)}
 
@@ -127,6 +129,14 @@ def _module_name(spec_text: str) -> str:
         if s.startswith("----") and "MODULE" in s:
             return s.split("MODULE", 1)[1].strip().strip("- ").split()[0]
     raise JobError("spec text has no ---- MODULE Name ---- header")
+
+
+def _loader_constants(constants: dict) -> dict:
+    """Job constants arrive as JSON, which has no set type: a list
+    value is the JSON spelling of an MC.cfg set literal ({r1, r2}),
+    which the loaders/evaluator represent as a frozenset."""
+    return {k: frozenset(v) if isinstance(v, list) else v
+            for k, v in constants.items()}
 
 
 def _result_dict(r, engine: str, pool_hit: bool = None) -> dict:
@@ -304,7 +314,15 @@ class Scheduler:
         head = batch[0]
         params = head.sweep_params()
         cfg_path = self._jobdir(head)
-        model = sw.load_anchored(cfg_path, params)
+        # the job's FIXED constants bake into the anchor (batch_signature
+        # already folds only equal-fixed jobs together, so head's dict
+        # speaks for the whole batch); two batches differing in a fixed
+        # override land on different class keys, not one shared engine
+        fixed = _loader_constants({
+            k: v for k, v in head.constants.items() if k not in params
+        })
+        model = sw.load_anchored(cfg_path, params,
+                                 const_overrides=fixed or None)
         pre = self.pool.hits
         entry = self.pool.get_sweep(model, params, **self._geometry(head))
         hit = self.pool.hits > pre
@@ -323,7 +341,11 @@ class Scheduler:
                                  sweep=j.sweep, constants=j.constants,
                                  batch=len(batch), pool_hit=hit))
             journals.append(jr)
-        results = entry.runner.run(configs)
+        try:
+            results = entry.runner.run(configs)
+        except BaseException:
+            self._abort_journals(journals)
+            raise
         with self._cond:
             self.batches_run += 1
             self.batched_jobs += len(batch)
@@ -349,9 +371,10 @@ class Scheduler:
 
         cfg_path = self._jobdir(job)
         try:
-            model = load(cfg_path, const_overrides={
-                k: v for k, v in job.constants.items()
-            } or None)
+            model = load(
+                cfg_path,
+                const_overrides=_loader_constants(job.constants) or None,
+            )
         except (StructLoadError, StructParseError, JobError):
             self._run_supervised(job)
             return
@@ -364,7 +387,11 @@ class Scheduler:
                  engine="pool", device=str(jax.devices()[0]),
                  params=dict(**geo, constants=job.constants,
                              pool_hit=hit))
-        r = entry.runner.run()
+        try:
+            r = entry.runner.run()
+        except BaseException:
+            self._abort_journals([jr])
+            raise
         if r.violation != 0:
             jr.event("violation", code=int(r.violation),
                      name=r.violation_name)
@@ -375,6 +402,21 @@ class Scheduler:
                  wall_s=round(r.wall_s, 6), interrupted=False)
         jr.close()
         self._finish_ok(job, _result_dict(r, "pool", pool_hit=hit))
+
+    def _abort_journals(self, journals) -> None:
+        """A runner that dies after the per-job journals opened must
+        still terminate them: SSE followers only stop on a 'final'
+        event, and an unclosed handle leaks per failed job (the loop's
+        error handler knows jobs, not files)."""
+        for jr in journals:
+            try:
+                jr.event("final", verdict="error", generated=0,
+                         distinct=0, depth=0, queue=0, wall_s=0.0,
+                         interrupted=True)
+            except Exception:
+                pass  # a sick journal must not mask the run's error
+            finally:
+                jr.close()
 
     def _run_supervised(self, job: Job) -> None:
         """Large / resilience-option jobs: the full api.run_check
@@ -389,6 +431,7 @@ class Scheduler:
         kw.setdefault("workers", "cpu" if _on_cpu() else "tpu")
         req = CheckRequest(
             config=cfg_path,
+            constants=_loader_constants(job.constants),
             journal=os.path.join(self.root,
                                  f"{job.id}.journal.jsonl"),
             noTool=True, out=out, err=out, **kw,

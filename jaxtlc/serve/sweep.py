@@ -40,7 +40,7 @@ values escape the anchored ranges halts with the codec range trap.
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -67,12 +67,21 @@ class SweepError(ValueError):
 
 
 def load_anchored(cfg_path: str,
-                  params: Dict[str, Tuple[int, int]]) -> StructModel:
+                  params: Dict[str, Tuple[int, int]],
+                  const_overrides: Optional[Dict[str, object]] = None,
+                  ) -> StructModel:
     """Load the model with every swept constant at its domain MAX (the
-    shape anchor: inferred integer ranges must cover the class)."""
-    return load(cfg_path, const_overrides={
-        c: int(hi) for c, (_lo, hi) in params.items()
-    })
+    shape anchor: inferred integer ranges must cover the class).
+
+    const_overrides carries a job's FIXED (non-swept) constants: they
+    bake into the anchor like any cfg value, so the model's digest,
+    canonical constants - and therefore `class_key` and every
+    `config_inits` fallback - all reflect them.  Swept names in the
+    dict are ignored (the anchor pins those to the domain max)."""
+    overrides = {k: v for k, v in (const_overrides or {}).items()
+                 if k not in params}
+    overrides.update({c: int(hi) for c, (_lo, hi) in params.items()})
+    return load(cfg_path, const_overrides=overrides)
 
 
 def class_key(model: StructModel,

@@ -192,6 +192,10 @@ def test_server_rejects_malformed_jobs(server):
         {"spec": "not a module", "cfg": _cfg(1)},  # no MODULE header
         # sweep job without its swept constant pinned
         {"spec": _TPB, "cfg": _cfg(1), "sweep": _SWEEP},
+        # sweep descriptor missing its 'hi' domain bound: a 400, not a
+        # KeyError-turned-500
+        {"spec": _TPB, "cfg": _cfg(1), "constants": {"MAXR": 1},
+         "sweep": {"const": "MAXR", "lo": 0}},
     ):
         with pytest.raises(urllib.error.HTTPError) as e:
             post(bad)
@@ -312,6 +316,118 @@ def test_sweep_matches_baked_constant_run_check(tmp_path, sweep_jobs):
 
 
 # ---------------------------------------------------------------------------
+# constant overrides reach every route (supervised + sweep anchor)
+# ---------------------------------------------------------------------------
+
+
+def _write_model(tmp_path, maxr: int = 2) -> str:
+    d = tmp_path / "model"
+    d.mkdir()
+    (d / "TwoPhaseB.tla").write_text(_TPB)
+    (d / "TwoPhaseB.cfg").write_text(_cfg(maxr))
+    return str(d / "TwoPhaseB.cfg")
+
+
+def test_check_request_constants_reach_the_frontend(tmp_path):
+    """CheckRequest.constants threads MC.cfg-style overrides through
+    frontend.resolve into the loaded model - the supervised server
+    path: a job's constants must shape the checked configuration, not
+    be silently dropped in favor of the cfg's baked values."""
+    from jaxtlc.frontend.model import resolve
+
+    cfg = _write_model(tmp_path, maxr=2)
+    spec = resolve(cfg, frontend="struct", const_overrides={"MAXR": 0})
+    assert spec.structmodel.constants["MAXR"] == 0
+    baked = resolve(cfg, frontend="struct")
+    assert baked.structmodel.constants["MAXR"] == 2
+    # overrides are digest material: a -recover / cache key can never
+    # confuse the two configurations
+    assert (spec.structmodel.source_digest
+            != baked.structmodel.source_digest)
+
+
+def test_sweep_anchor_honors_fixed_overrides(tmp_path):
+    """load_anchored bakes a job's FIXED (non-swept) constants into the
+    anchor model: config_inits' fallback values and the constants-CLASS
+    pool key both reflect them, so two sweep batches differing only in
+    a fixed override cannot share one warm engine."""
+    from jaxtlc.serve import sweep as sw
+
+    cfg = _write_model(tmp_path, maxr=2)
+    params = {"MAXR": (0, 2)}
+    base = sw.load_anchored(cfg, params)
+    ov = sw.load_anchored(cfg, params,
+                          const_overrides={"RM": frozenset({"r1"})})
+    assert base.constants["RM"] == frozenset({"r1", "r2"})
+    assert ov.constants["RM"] == frozenset({"r1"})
+    # the anchor still pins swept constants at their domain max, even
+    # when the job's dict carries a swept value too
+    both = sw.load_anchored(cfg, params,
+                            const_overrides={"MAXR": 0,
+                                             "RM": frozenset({"r1"})})
+    assert both.constants["MAXR"] == 2
+    assert sw.class_key(ov, params) != sw.class_key(base, params)
+    assert sw.class_key(both, params) == sw.class_key(ov, params)
+
+
+def test_job_constants_json_sets_normalize():
+    """JSON has no set type: a list value in a job's constants is the
+    JSON spelling of an MC.cfg set literal and becomes the loaders'
+    frozenset representation on every route."""
+    from jaxtlc.serve.scheduler import _loader_constants
+
+    assert _loader_constants({"RM": ["r1", "r2"], "MAXR": 1}) == \
+        {"RM": frozenset({"r1", "r2"}), "MAXR": 1}
+
+
+def test_failed_runner_finalizes_job_journals(tmp_path):
+    """A runner that explodes after the per-job journals opened must
+    not leak handles or hang SSE followers: every affected job's
+    journal still ends with a final error event, and the job records
+    the error.  Covers both scheduler-owned paths (sweep + pool)."""
+    from types import SimpleNamespace
+
+    from jaxtlc.obs import journal as jrn
+    from jaxtlc.serve.scheduler import Scheduler
+
+    def _boom(*_a, **_k):
+        raise RuntimeError("boom")
+
+    class _BoomPool:
+        sweep_width = 4
+        hits = 0
+
+        def get_sweep(self, model, params, **geo):
+            return SimpleNamespace(runner=SimpleNamespace(run=_boom))
+
+        def get_single(self, model, **geo):
+            return SimpleNamespace(runner=SimpleNamespace(run=_boom))
+
+    sched = Scheduler(str(tmp_path), pool=_BoomPool())
+    try:
+        jobs = [
+            sched.submit(_TPB, _cfg(2), name=f"boom-sweep{v}",
+                         constants={"MAXR": v}, sweep=_SWEEP,
+                         options=_OPTS)
+            for v in (0, 1)
+        ]
+        jobs.append(sched.submit(_TPB, _cfg(2), name="boom-plain",
+                                 options=_OPTS))
+        assert sched.drain(timeout=60)
+    finally:
+        sched.shutdown()
+    for job in jobs:
+        assert job.state == "error" and "boom" in job.error
+        events = jrn.read(
+            os.path.join(str(tmp_path), f"{job.id}.journal.jsonl")
+        )
+        assert events[0]["event"] == "run_start"
+        assert events[-1]["event"] == "final"
+        assert events[-1]["verdict"] == "error"
+        assert events[-1]["interrupted"] is True
+
+
+# ---------------------------------------------------------------------------
 # satellites: memo cap + stats, pool LRU, batched fsync
 # ---------------------------------------------------------------------------
 
@@ -361,6 +477,10 @@ def test_engine_pool_lru_eviction_and_stats():
         (1, 4, 2, 2)
     assert s["compiles"] == 4
     assert "xla_compiles" in s and "memo" in s
+    # this jax exposes the public monitoring hook, so the zero-compile
+    # contract has its ground truth (a jax without it degrades the
+    # meter to "unavailable" instead of breaking pool construction)
+    assert s["xla_meter"] == "ok"
 
 
 def test_journal_batched_fsync(tmp_path, monkeypatch):
