@@ -68,6 +68,10 @@ class AnalysisReport:
     findings: List[Finding] = dataclasses.field(default_factory=list)
     spec: Optional[object] = None  # speclint.SpecAnalysis
     engine_lines: List[str] = dataclasses.field(default_factory=list)
+    # certified-bound report section (absint.BoundReport.render_lines);
+    # empty on reports that did not run the abstract interpretation, so
+    # pre-existing golden reports render byte-identically
+    bound_lines: List[str] = dataclasses.field(default_factory=list)
     wall_s: float = 0.0
 
     def extend(self, findings) -> None:

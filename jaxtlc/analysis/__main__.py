@@ -1,12 +1,20 @@
 """``python -m jaxtlc.analysis`` - the standalone preflight runner.
 
     python -m jaxtlc.analysis path/to/MC.cfg [--deep] [--journal PATH]
+                              [--sweep NAME=LO..HI]...
     python -m jaxtlc.analysis --self-check [--tiny]
+    python -m jaxtlc.analysis --gate [SPECS_DIR]
 
 The first form runs the preflight suite on a model (the same pass the
-CLI runs before a check) and prints the full report; the second audits
-every shipped engine factory (selfcheck.FACTORIES).  Exit status: 0
-clean or warnings only, nonzero on error-severity findings.
+CLI runs before a check) and prints the full report - ``--deep`` adds
+the engine jaxpr trace AND the certified bound report, ``--sweep``
+widens a swept integer CONSTANT to its whole lo..hi range so the
+slot/trap budget audit and the bound report cover the sweep constants
+CLASS instead of just the anchor configuration (the jaxtlc.serve sweep
+contract).  The second audits every shipped engine factory
+(selfcheck.FACTORIES).  The third runs the engine-free lint gate over
+a specs tree (tools/lintgate.py's pass).  Exit status: 0 clean or
+warnings only, nonzero on error-severity findings.
 """
 
 from __future__ import annotations
@@ -15,23 +23,62 @@ import argparse
 import sys
 
 
+def _parse_sweep(items):
+    """--sweep NAME=LO..HI descriptors -> {name: (lo, hi)}."""
+    out = {}
+    for it in items or ():
+        try:
+            name, rng = it.split("=", 1)
+            lo, hi = rng.split("..", 1)
+            out[name.strip()] = (int(lo), int(hi))
+        except ValueError:
+            raise SystemExit(
+                f"error: bad --sweep descriptor {it!r} "
+                "(want NAME=LO..HI, e.g. MAXR=1..3)"
+            )
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m jaxtlc.analysis")
     p.add_argument("config", nargs="?", default="",
-                   help="path to MC.cfg (preflight that model)")
+                   help="path to MC.cfg (preflight that model); with "
+                        "--gate, a specs directory instead")
     p.add_argument("--deep", action="store_true",
-                   help="also trace the engine jaxpr (purity audit); "
-                        "tracing only, never an XLA compile")
+                   help="also trace the engine jaxpr (purity audit; "
+                        "tracing only, never an XLA compile) and "
+                        "render the certified bound report")
+    p.add_argument("--sweep", action="append", default=[],
+                   metavar="NAME=LO..HI",
+                   help="widen CONSTANT NAME over LO..HI so the audit "
+                        "covers the whole sweep constants class, not "
+                        "just the anchor configuration (repeatable)")
     p.add_argument("--journal", default="", metavar="PATH",
                    help="append the findings as schema-validated "
                         "`analysis` events to PATH")
     p.add_argument("--self-check", action="store_true",
                    dest="self_check",
                    help="audit every shipped engine factory (fused, "
-                        "pipelined, sharded, struct, enumerator)")
+                        "narrowed, pipelined, sharded, struct, "
+                        "enumerator, ...)")
+    p.add_argument("--gate", action="store_true",
+                   help="engine-free lint gate: speclint + absint over "
+                        "every MC.cfg under the given directory "
+                        "(default specs/); nonzero on error findings")
     p.add_argument("--tiny", action="store_true",
                    help="tiny geometries (the tier-1 smoke mode)")
     args = p.parse_args(argv)
+
+    if args.gate:
+        import os
+
+        from .gate import run_gate
+
+        root = args.config or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "specs",
+        )
+        return run_gate(root)
 
     if args.self_check:
         from .selfcheck import self_check
@@ -62,10 +109,40 @@ def main(argv=None) -> int:
 
     sizes = dict(fp_capacity=1 << 20, chunk=1024,
                  queue_capacity=1 << 15)
+    if args.sweep and not isinstance(spec, StructRunSpec):
+        print("error: --sweep needs a struct-frontend spec",
+              file=sys.stderr)
+        return 2
     if isinstance(spec, StructRunSpec):
+        sweep = _parse_sweep(args.sweep)
+        const_hints = None
+        extra_systems = ()
+        if sweep:
+            from ..struct.shapes import SInt
+
+            sm = spec.structmodel
+            const_hints = {n: SInt(lo, hi)
+                           for n, (lo, hi) in sweep.items()}
+            # each configuration's Init set seeds the bound env (the
+            # anchor's initial states alone would under-approximate)
+            extra_systems = []
+            import itertools
+
+            names = sorted(sweep)
+            ranges = [range(sweep[n][0], sweep[n][1] + 1)
+                      for n in names]
+            for combo in itertools.product(*ranges):
+                consts = dict(sm.constants)
+                consts.update(dict(zip(names, combo)))
+                extra_systems.append(
+                    sm.system.with_constants(consts)
+                )
         report = preflight_struct(
             spec.structmodel, deep=args.deep,
-            check_deadlock=spec.check_deadlock, **sizes,
+            check_deadlock=spec.check_deadlock,
+            bounds=True if (args.deep or sweep) else None,
+            const_hints=const_hints,
+            extra_init_systems=tuple(extra_systems), **sizes,
         )
     elif isinstance(spec, GenRunSpec):
         report = preflight_gen(spec.genspec,

@@ -20,16 +20,48 @@ from .engine_audit import audit_counter_width, audit_engine
 def preflight_struct(model, *, fp_capacity: int, chunk: int,
                      queue_capacity: int, check_deadlock: bool = True,
                      deep: bool = False,
-                     backend=None) -> AnalysisReport:
+                     backend=None, bounds=None, narrow: bool = False,
+                     const_hints=None,
+                     extra_init_systems=()) -> AnalysisReport:
     """Struct-path preflight: spec lints + engine-layer arithmetic;
-    deep mode traces the (memoized) struct engine's step."""
+    deep mode traces the (memoized) struct engine's step.  `bounds`
+    (absint.BoundReport - or True to compute one here) adds the
+    certified-bound report section and its findings; `narrow` marks
+    that the run intends to use the narrowed codec, which escalates an
+    uncertified report to a visible warning.  `const_hints` /
+    `extra_init_systems` widen the analysis over a sweep constants
+    CLASS (jaxtlc.analysis --sweep)."""
     from .speclint import analyze_spec
 
     t0 = time.time()
     report = AnalysisReport(name=f"struct:{model.root_name}")
-    spec = analyze_spec(model)
+    dynamic = frozenset(const_hints or ())
+    spec = analyze_spec(model, dynamic_consts=dynamic,
+                        const_hints=const_hints)
     report.spec = spec
     report.extend(spec.findings)
+    if bounds is True or (bounds is None and (const_hints
+                                              or extra_init_systems)):
+        from .absint import analyze_bounds
+
+        bounds = analyze_bounds(model, const_hints=const_hints,
+                                extra_init_systems=extra_init_systems)
+    if bounds is not None:
+        report.bound_lines = bounds.render_lines()
+        report.extend(bounds.findings())
+        if narrow and not bounds.certified:
+            # the -narrow request could not be honored; the run
+            # proceeds on the baseline layout - say so loudly enough
+            # that the user notices the flag did nothing
+            from . import SEV_WARNING, Finding
+
+            report.findings.append(Finding(
+                layer="spec", check="narrow-refused",
+                severity=SEV_WARNING, subject=model.root_name,
+                detail=("-narrow requested but the bound report is "
+                        "not certified; running with the baseline "
+                        "(un-narrowed) codec"),
+            ))
     n_lanes = None
     if backend is None and deep:
         from ..struct.cache import get_backend

@@ -60,6 +60,8 @@ def render_report(report: AnalysisReport) -> str:
     lines = [f"preflight analysis: {report.name}"]
     if report.spec is not None:
         lines.extend(render_spec_section(report.spec))
+    if report.bound_lines:
+        lines.extend(report.bound_lines)
     if report.engine_lines:
         lines.append("engine layer:")
         lines.extend(f"  {ln}" for ln in report.engine_lines)

@@ -93,6 +93,32 @@ def _build_struct():
                 n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
 
 
+def _build_narrowed():
+    # the certified-bound narrowed struct engine (ISSUE 10): the same
+    # TwoPhase model as "struct" but compiled against the certified
+    # reachable bounds with the runtime certificate check on - the
+    # narrowed codec + cert column path cannot ship unaudited
+    import os
+
+    from ..engine.bfs import make_backend_engine
+    from ..struct.cache import get_backend, get_bounds
+    from ..struct.loader import load
+
+    d = _specs_dir()
+    if d is None:
+        raise FileNotFoundError("specs/ directory not found")
+    model = load(os.path.join(d, "TwoPhase.toolbox", "Model_1",
+                              "MC.cfg"))
+    bounds = get_bounds(model)
+    b = get_backend(model, True, bounds=bounds)
+    assert b.cert_check is not None, "narrowed factory must carry cert"
+    init_fn, run_fn, step_fn = make_backend_engine(
+        b, donate=False, obs_slots=8, **_TINY
+    )
+    return dict(init_fn=init_fn, run_fn=run_fn, step_fn=step_fn,
+                n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
+
+
 def _build_enumerator():
     from ..engine.bfs import make_enumerator
 
@@ -202,6 +228,7 @@ def _build_phased():
 # by tier-1 so a new engine path cannot ship unaudited
 FACTORIES: Dict[str, Callable[[], dict]] = {
     "fused": _build_fused,
+    "narrowed": _build_narrowed,
     "phased": _build_phased,
     "pipelined": _build_pipelined,
     "sharded": _build_sharded,

@@ -241,19 +241,27 @@ class _Branch:
 
 
 class _SpecWalker:
-    def __init__(self, model, var_shapes):
+    def __init__(self, model, var_shapes,
+                 dynamic_consts=frozenset(), const_hints=None):
         self.model = model
         self.system = model.system
         self.ev = self.system.ev
         self.variables = set(self.system.variables)
         self.defs = self.ev.defs
         self.var_shapes = var_shapes
+        # constants swept over a range (jaxtlc.analysis --sweep): not
+        # constant-evaluable - guards mentioning them are classified
+        # like state-dependent ones, so the class audit never calls an
+        # action unreachable on the strength of ONE configuration
+        self.dynamic_consts = frozenset(dynamic_consts)
         # shape oracle for quantifier-domain classification: reuse the
         # compiler's own abstract interpreter over the final shapes
         self._inf = ShapeInference.__new__(ShapeInference)
         self._inf.ev = self.ev
         self._inf.variables = self.system.variables
         self._inf.var_shapes = dict(var_shapes)
+        if const_hints:
+            self._inf.const_hints = dict(const_hints)
         self.branches: Dict[str, List[_Branch]] = {}
 
     # -- helpers -----------------------------------------------------------
@@ -367,9 +375,11 @@ class _SpecWalker:
 
     def _guard_static_false(self, g, br: _Branch) -> bool:
         """True when guard `g` is constant-evaluable (no state vars, no
-        binders, no primes) and evaluates FALSE under the resolved
-        constants - TLC's level-0 constant evaluation."""
-        if _mentions_any(g, self.variables | br.bound, self.defs):
+        binders, no primes, no swept constants) and evaluates FALSE
+        under the resolved constants - TLC's level-0 constant
+        evaluation."""
+        if _mentions_any(g, self.variables | br.bound
+                         | self.dynamic_consts, self.defs):
             return False
         try:
             v = self.ev.eval(g, dict(self.ev.constants))
@@ -480,20 +490,26 @@ class _SpecWalker:
 # ---------------------------------------------------------------------------
 
 
-def analyze_spec(model, var_shapes: Optional[dict] = None) -> SpecAnalysis:
+def analyze_spec(model, var_shapes: Optional[dict] = None,
+                 dynamic_consts=frozenset(),
+                 const_hints=None) -> SpecAnalysis:
     """Run the spec-layer lints on a loaded StructModel.  `var_shapes`
     reuses already-inferred shapes (the struct backend memo computes
-    them anyway); omitted, the same pure-Python inference runs here."""
+    them anyway); omitted, the same pure-Python inference runs here.
+    `dynamic_consts` names CONSTANTs swept over a range and
+    `const_hints` widens them to abstract values, so one pass audits a
+    whole sweep constants class instead of its anchor configuration."""
     system = model.system
     if var_shapes is None:
         hints = typeok_hints(system.ev, model.invariants,
                              system.variables)
         var_shapes = infer_shapes(system.ev, system.variables,
                                   system.init_ast, system.next_ast,
-                                  hints=hints)
+                                  hints=hints, const_hints=const_hints)
     cdc = StructCodec(system.variables, var_shapes)
 
-    w = _SpecWalker(model, var_shapes)
+    w = _SpecWalker(model, var_shapes, dynamic_consts=dynamic_consts,
+                    const_hints=const_hints)
     w.walk()
 
     actions: Dict[str, ActionInfo] = {}

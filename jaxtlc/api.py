@@ -70,6 +70,7 @@ class CheckRequest:
     xprof: str = ""
     analyze: bool = False
     preflight: bool = True
+    narrow: bool = False
     coverage: bool = False
     liveness: bool = False
     liveness_host: bool = False
@@ -646,6 +647,8 @@ def _resume_command(args) -> str:
         parts += ["-sharded", str(args.sharded)]
     if args.pipeline:
         parts += ["-pipeline"]  # checkpoints only resume in the same mode
+    if getattr(args, "narrow", False):
+        parts += ["-narrow"]  # the narrowed codec is a different layout
     if args.frontend != "auto":
         parts += ["-frontend", args.frontend]
     if not args.checkpoint:
@@ -846,6 +849,18 @@ def _run_check_struct(args, spec) -> int:
         return 1
     log_holder = []
 
+    # -narrow: the certified-bound narrowed codec (analysis.absint).
+    # Only a CERTIFIED report narrows; an uncertified one keeps the
+    # baseline layout and says so up front (the run stays correct
+    # either way - runtime traps / the certificate column enforce it)
+    bounds = None
+    if args.narrow:
+        from .struct.cache import get_bounds
+
+        bounds = get_bounds(sm)
+        if not bounds.certified:
+            bounds = None
+
     def check():
         log = log_holder[0]
         ckd = spec.check_deadlock
@@ -861,8 +876,10 @@ def _run_check_struct(args, spec) -> int:
                 from .resil import check_sharded_supervised
 
                 sup = check_sharded_supervised(
-                    None, mesh, backend=get_backend(sm, ckd),
-                    meta_config=struct_meta_config(sm),
+                    None, mesh,
+                    backend=get_backend(sm, ckd, bounds=bounds,
+                                        elide=False),
+                    meta_config=struct_meta_config(sm, bounds=bounds),
                     route_factor=args.routefactor,
                     pipeline=args.pipeline,
                     obs_slots=_obs_slots(args),
@@ -872,15 +889,16 @@ def _run_check_struct(args, spec) -> int:
             return check_struct_sharded(
                 sm, mesh, route_factor=args.routefactor,
                 check_deadlock=ckd, pipeline=args.pipeline,
-                obs_slots=_obs_slots(args), **kw,
+                obs_slots=_obs_slots(args), bounds=bounds, **kw,
             ), None
         if args.checkpoint or args.autogrow:
             from .resil import check_supervised
 
             sup = check_supervised(
                 None, fp_index=spec.fp_index,
-                backend=get_backend(sm, ckd),
-                meta_config=struct_meta_config(sm), check_deadlock=ckd,
+                backend=get_backend(sm, ckd, bounds=bounds),
+                meta_config=struct_meta_config(sm, bounds=bounds),
+                check_deadlock=ckd,
                 pipeline=args.pipeline,
                 obs_slots=_obs_slots(args),
                 opts=_sup_opts(args, log), **kw,
@@ -888,7 +906,8 @@ def _run_check_struct(args, spec) -> int:
             return sup.result, sup
         return check_struct(
             sm, fp_index=spec.fp_index, check_deadlock=ckd,
-            pipeline=args.pipeline, obs_slots=_obs_slots(args), **kw,
+            pipeline=args.pipeline, obs_slots=_obs_slots(args),
+            bounds=bounds, **kw,
         ), None
 
     def props():
@@ -944,10 +963,19 @@ def _struct_preflight(args, spec, sm, deep):
         from .struct.cache import get_backend
 
         backend = get_backend(sm, spec.check_deadlock)
+    # the certified bound report rides along in deep mode (-analyze)
+    # and whenever -narrow is in play (the user should see what the
+    # narrowed codec is built from / why narrowing was refused)
+    bounds = None
+    if deep or args.narrow:
+        from .struct.cache import get_bounds
+
+        bounds = get_bounds(sm)
     return preflight_struct(
         sm, fp_capacity=args.fpcap, chunk=args.chunk,
         queue_capacity=args.qcap, check_deadlock=spec.check_deadlock,
-        deep=deep, backend=backend,
+        deep=deep, backend=backend, bounds=bounds,
+        narrow=args.narrow,
     )
 
 
@@ -1044,6 +1072,31 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
         log.final_counts(r.generated, r.distinct, r.queue_left)
         _finish_journal(args, log, r=None, sup=sup)
         return EXIT_INTERRUPTED
+    if getattr(r, "cert_violated", False):
+        # the runtime certificate tripped: a reachable state violated a
+        # bound the certified abstract interpretation claimed, so every
+        # count this narrowed run produced is untrustworthy.  Loud
+        # error verdict, never a silent narrowing (the views banner
+        # already fired at the level event; this is the structured
+        # record + the exit code)
+        detail = ("runtime certificate violation: a reachable state "
+                  "lies outside the certified bounds the narrowed "
+                  "codec was built from; re-run with -no-narrow and "
+                  "report the spec (the bound certification is "
+                  "unsound)")
+        j = getattr(args, "_journal", None)
+        if j is not None:
+            j.event("analysis", layer="spec", check="bound-certificate",
+                    severity="error", subject=spec.spec_name,
+                    detail=detail)
+            j.event("final", verdict="error", generated=r.generated,
+                    distinct=r.distinct, depth=r.depth,
+                    queue=r.queue_left,
+                    wall_s=round(time.time() - t0, 6),
+                    interrupted=False)
+        log.msg(1000, f"ERROR: {detail}", severity=1)
+        _finish_journal(args, log)
+        return 1
     violated = r.violation != 0
     liveness_violated = False
     if not violated and spec.properties:

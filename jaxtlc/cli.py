@@ -209,6 +209,24 @@ def main(argv=None) -> int:
                    help="wrap the check in a jax.profiler trace writing "
                         "to DIR (the ground-truth device timeline; "
                         "view with TensorBoard/XProf)")
+    c.add_argument("-narrow", dest="narrow", action="store_true",
+                   default=False,
+                   help="struct frontend: run on the certified-bound "
+                        "NARROWED codec (jaxtlc.analysis.absint): enum "
+                        "universes, mask bit counts and sequence caps "
+                        "shrink to the certified reachable ranges, "
+                        "cutting packed uint32 words through the "
+                        "fingerprint/sort/probe path.  Counts and "
+                        "verdict are identical to an un-narrowed run "
+                        "(fingerprints differ - a different packing); "
+                        "the on-device runtime certificate re-verifies "
+                        "every claimed bound on every generated state "
+                        "and escalates any violation to an error "
+                        "verdict.  Refused (baseline layout, with a "
+                        "warning) when the bound report cannot be "
+                        "certified")
+    c.add_argument("-no-narrow", dest="narrow", action="store_false",
+                   help="(default) the baseline widened codec layout")
     c.add_argument("-analyze", action="store_true",
                    help="deep preflight: in addition to the default "
                         "spec-IR lints and counter-width arithmetic, "

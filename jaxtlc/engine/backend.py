@@ -71,6 +71,12 @@ class SpecBackend(NamedTuple):
     # the pipelined step (the commit half - dedup/enqueue/counters - is
     # engine-owned and backend-independent)
     expand: object = None
+    # optional runtime certificate check (certified-bound narrowing,
+    # analysis.absint): fn(flat [N, F] int32, valid [N] bool) -> bool
+    # scalar "some valid successor violates a claimed bound".  Pure
+    # telemetry into the sticky certificate carry/ring column - it
+    # feeds no arbitration, so narrowed counts stay comparable
+    cert_check: object = None
 
 
 class ExpandOut(NamedTuple):
@@ -90,6 +96,10 @@ class ExpandOut(NamedTuple):
     viol: jnp.ndarray  # int32 first-wins expand-stage violation code
     viol_state: jnp.ndarray  # [F] int32
     viol_action: jnp.ndarray  # int32
+    # bool scalar: some valid successor of this block violated a
+    # certified bound (None on backends without a cert_check, so
+    # pre-certificate carries/stages keep their exact pytree layout)
+    cert: jnp.ndarray = None
 
 
 def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
@@ -144,6 +154,13 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
         packed = cdc.pack(flat)
         lo, hi = fp64_words_mxu(packed, nbits, fp_index, seed)
 
+        # runtime certificate: verify the claimed bounds on the RAW
+        # (pre-pack) fields of every valid successor - escapes that
+        # would wrap into a legal-looking packed word are still caught
+        cert = None
+        if backend.cert_check is not None:
+            cert = backend.cert_check(flat, fvalid)
+
         # per-action generated counters, scatter-free: the backend's
         # factorized hook (KubeAPI dispatch structure, PERF.md item 5)
         # when it has one, a [L, n_labels] fold for static lane
@@ -191,7 +208,7 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
         return ExpandOut(
             packed=packed, lo=lo, hi=hi, valid=fvalid, action=faction,
             gen=gen, viol=viol, viol_state=viol_state,
-            viol_action=viol_action,
+            viol_action=viol_action, cert=cert,
         )
 
     return expand

@@ -132,6 +132,13 @@ class EngineCarry(NamedTuple):
     # host RAM - engine.spill).  Present only on spill-mode carries, so
     # every other engine keeps its exact checkpoint layout.
     spill_hits: jnp.ndarray = None  # uint32
+    # --- runtime certificate (None without a backend cert_check) -------
+    # Sticky bool: some generated state violated a bound the certified
+    # abstract interpretation claimed (analysis.absint).  Latched every
+    # body, mirrored into the obs ring's COL_CERT, escalated to an
+    # error verdict by the check drivers - never silent.
+    cert_viol: jnp.ndarray = None  # bool
+    st_cert: jnp.ndarray = None  # staged block's cert bit (pipelined)
 
 
 class CheckResult(NamedTuple):
@@ -158,6 +165,11 @@ class CheckResult(NamedTuple):
     # Reported on the 2193 stats line so users can size fp_capacity (and
     # see how close a run came to the fp_highwater regrow trigger)
     fp_occupancy: float = None
+    # runtime-certificate verdict of a narrowed (certified-bound) run:
+    # None = no certificate check carried; False = every generated
+    # state satisfied the certified bounds; True = a claimed bound was
+    # VIOLATED - the check drivers escalate this to an error verdict
+    cert_violated: bool = None
 
 
 def carry_done(carry: EngineCarry) -> bool:
@@ -427,6 +439,12 @@ def make_stage_pair(
             extra["spill_hits"] = c.spill_hits + (
                 veto & ex.valid
             ).sum().astype(jnp.uint32)
+        cert_now = None
+        if ex.cert is not None and c.cert_viol is not None:
+            # sticky: once any block's certificate check fired, every
+            # later carry (and ring row) carries the flag
+            cert_now = c.cert_viol | ex.cert
+            extra["cert_viol"] = cert_now
         obs = {}
         if obs_slots:
             # one telemetry row per completed level (post-commit
@@ -451,6 +469,7 @@ def make_stage_pair(
                 act_dist[:n_labels],
                 overflow=sticky_overflow(c.obs_ring, wrapped),
                 spill=extra.get("spill_hits"),
+                cert=cert_now,
             )
             ring, head = ring_update(
                 c.obs_ring, c.obs_head, row, level_done
@@ -549,6 +568,7 @@ def make_backend_engine(
     from .backend import ExpandOut
 
     assert 0.0 < fp_highwater <= 1.0, "fp_highwater must be in (0, 1]"
+    has_cert = backend.cert_check is not None
     cdc = backend.cdc
     F = cdc.n_fields
     W = (cdc.nbits + 31) // 32
@@ -618,6 +638,10 @@ def make_backend_engine(
                 st_viol_state=jnp.zeros(F, jnp.int32),
                 st_viol_action=jnp.int32(-1),
             )
+            if has_cert:
+                staged["st_cert"] = jnp.bool_(False)
+        if has_cert:
+            staged["cert_viol"] = jnp.bool_(False)
         obs = {}
         if obs_slots:
             ring, head = ring_new(obs_slots, n_labels)
@@ -672,11 +696,12 @@ def make_backend_engine(
         pop_expand, commit = make_stages(chunk)
 
         def with_staged(c: EngineCarry, ex, n) -> EngineCarry:
+            extra = {"st_cert": ex.cert} if has_cert else {}
             return c._replace(
                 st_packed=ex.packed, st_lo=ex.lo, st_hi=ex.hi,
                 st_valid=ex.valid, st_action=ex.action, st_gen=ex.gen,
                 st_n=n, st_viol=ex.viol, st_viol_state=ex.viol_state,
-                st_viol_action=ex.viol_action,
+                st_viol_action=ex.viol_action, **extra,
             )
 
         def staged_ex(c: EngineCarry) -> ExpandOut:
@@ -685,6 +710,7 @@ def make_backend_engine(
                 valid=c.st_valid, action=c.st_action, gen=c.st_gen,
                 viol=c.st_viol, viol_state=c.st_viol_state,
                 viol_action=c.st_viol_action,
+                cert=c.st_cert if has_cert else None,
             )
 
         # The two-deep pipeline body, bubble-free: the staged block k-1
@@ -1034,6 +1060,8 @@ def result_from_carry(
     # a pipelined carry's staged block is popped but uncommitted work -
     # still "on queue" in TLC's sense (states handed to a worker)
     staged_n = int(carry.st_n) if carry.st_n is not None else 0
+    cert = getattr(carry, "cert_viol", None)
+    cert_violated = bool(cert) if cert is not None else None
     return CheckResult(
         generated=int(carry.generated),
         distinct=int(carry.distinct),
@@ -1056,4 +1084,5 @@ def result_from_carry(
         iterations=iterations,
         outdegree=outdegree,
         fp_occupancy=occupancy,
+        cert_violated=cert_violated,
     )

@@ -33,8 +33,15 @@ accuracy):
                       host fingerprint store vetoed (engine.spill);
                       always 0 on engines without the spill tier, so
                       pre-spill ring layouts are unchanged
-    col 8..8+A-1      per-action generated (cumulative)
-    col 8+A..8+2A-1   per-action distinct  (cumulative)
+    col 8  cert       STICKY certificate flag: 1 once any generated
+                      state violated a bound the certified abstract
+                      interpretation (jaxtlc.analysis.absint) claimed -
+                      decoded as `cert_violation` and escalated to an
+                      error verdict, so an unsound narrowing can never
+                      silently drop real states; always 0 on engines
+                      without a certificate check
+    col 9..9+A-1      per-action generated (cumulative)
+    col 9+A..9+2A-1   per-action distinct  (cumulative)
 
 The ring array is [slots + 1, cols]: row `slots` is the dump row.
 `head` counts rows ever written (the slot of row k is k % slots), so
@@ -49,9 +56,9 @@ import numpy as np
 
 DEFAULT_OBS_SLOTS = 256
 
-N_FIXED_COLS = 8
+N_FIXED_COLS = 9
 (COL_LEVEL, COL_GENERATED, COL_DISTINCT, COL_QUEUE, COL_BODIES,
- COL_EXPANDED, COL_OVERFLOW, COL_SPILL) = range(N_FIXED_COLS)
+ COL_EXPANDED, COL_OVERFLOW, COL_SPILL, COL_CERT) = range(N_FIXED_COLS)
 COL_RES0 = COL_OVERFLOW  # pre-overflow name of col 6
 COL_RES1 = COL_SPILL  # pre-spill name of col 7
 
@@ -87,11 +94,12 @@ def ring_update(ring, head, row, flip):
 
 
 def pack_row(level, generated, distinct, queue, bodies, expanded,
-             act_gen, act_dist, overflow=None, spill=None):
+             act_gen, act_dist, overflow=None, spill=None, cert=None):
     """Assemble one ring row from carry scalars (device-side).
     `overflow` is the sticky uint32 saturation flag (COL_OVERFLOW);
-    `spill` the cumulative host-spill-hit counter (COL_SPILL); None
-    writes 0 (engines that predate the flag / carry no spill tier)."""
+    `spill` the cumulative host-spill-hit counter (COL_SPILL); `cert`
+    the sticky certificate-violation flag (COL_CERT); None writes 0
+    (engines that predate the flag / carry no such tier)."""
     import jax.numpy as jnp
 
     u = jnp.uint32
@@ -100,6 +108,7 @@ def pack_row(level, generated, distinct, queue, bodies, expanded,
         queue.astype(u), bodies.astype(u), expanded.astype(u),
         u(0) if overflow is None else overflow.astype(u),
         u(0) if spill is None else spill.astype(u),
+        u(0) if cert is None else cert.astype(u),
     ])
     return jnp.concatenate(
         [fixed, act_gen.astype(u), act_dist.astype(u)]
@@ -165,6 +174,10 @@ def rows_from_ring(
         if r[COL_SPILL]:
             # host spill tier active: cumulative host-store vetoes
             row["spill_hits"] = int(r[COL_SPILL])
+        if r[COL_CERT]:
+            # sticky certificate flag: a generated state violated a
+            # bound the certified abstract interpretation claimed
+            row["cert_violation"] = True
         if labels is not None:
             a = len(labels)
             gen = r[N_FIXED_COLS:N_FIXED_COLS + a]

@@ -170,6 +170,16 @@ def migrate_engine_carry(
         staged["spill_hits"] = jnp.asarray(
             np.asarray(carry.spill_hits), jnp.uint32
         )
+    # runtime-certificate leaves: sticky flag + staged block bit travel
+    # verbatim (telemetry; a violation already seen must survive regrow)
+    if getattr(carry, "cert_viol", None) is not None:
+        staged["cert_viol"] = jnp.asarray(
+            np.asarray(carry.cert_viol), bool
+        )
+    if getattr(carry, "st_cert", None) is not None:
+        staged["st_cert"] = jnp.asarray(
+            np.asarray(carry.st_cert), bool
+        )
 
     return EngineCarry(
         fps=fps2,

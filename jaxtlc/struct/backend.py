@@ -27,7 +27,7 @@ import numpy as np
 from ..engine.backend import SpecBackend
 from ..engine.bfs import VIOL_ASSERT
 from .codec import StructCodec
-from .compile import LaneCompiler
+from .compile import LaneCompiler, TrapPolicy
 from .loader import StructModel
 from .shapes import infer_shapes, typeok_hints
 
@@ -43,17 +43,90 @@ def struct_viol_names(model: StructModel) -> Dict[int, str]:
     return names
 
 
+def make_cert_check(cdc: StructCodec, card_specs=()):
+    """The on-device runtime certificate check for a (narrowed) codec:
+    every VALID generated successor's raw int32 fields must hold a
+    legal code of its universe claim (0 <= field <= max_code - checked
+    PRE-packing, so escapes that would wrap into a legal-looking word
+    are still caught), and every cardinality-bounded mask variable's
+    popcount must fit its certified bound.  Returns a scalar bool:
+    "some reachable state violated a claimed bound" - the signal the
+    engines latch into the sticky certificate column."""
+    from jax import lax
+
+    max_code = jnp.asarray(np.asarray(cdc.max_codes(), np.int32))
+    specs = tuple((int(off), int(nf), int(bound))
+                  for off, nf, bound in card_specs)
+
+    def cert_check(flat, valid):
+        bad = (flat < 0) | (flat > max_code[None, :])
+        viol = bad.any(axis=1)
+        for off, nf, bound in specs:
+            pc = lax.population_count(
+                flat[:, off:off + nf].astype(jnp.uint32)
+            )
+            viol = viol | (pc.sum(axis=1).astype(jnp.int32) > bound)
+        return (viol & valid).any()
+
+    return cert_check
+
+
+def _card_specs(cdc: StructCodec, variables, card_bounds) -> list:
+    """(field offset, field count, bound) triples for the mask-layout
+    variables whose certified cardinality bound actually constrains."""
+    from .codec import MaskLeaf
+
+    out = []
+    for v, lay in zip(variables, cdc.layouts):
+        bound = (card_bounds or {}).get(v)
+        if bound is None or not isinstance(lay, MaskLeaf):
+            continue
+        if bound < lay.n_bits:
+            out.append((cdc.offsets[v], lay.n_fields, bound))
+    return out
+
+
 def struct_backend(model: StructModel,
-                   check_deadlock: bool = True) -> SpecBackend:
+                   check_deadlock: bool = True,
+                   bounds=None,
+                   elide: bool = True) -> SpecBackend:
     """Compile `model` into a SpecBackend: parse -> shape-infer ->
-    lane-compile, the pipeline struct.cache memoizes in-process."""
+    lane-compile, the pipeline struct.cache memoizes in-process.
+
+    `bounds` (a CERTIFIED analysis.absint.BoundReport) swaps the
+    widened inferred shapes for the certified reachable bounds: the
+    codec's enum universes, mask bit counts and sequence caps shrink
+    to the certified ranges (fewer packed uint32 words through the
+    fingerprint/sort/probe path) and, with `elide` (default), the
+    compiler drops the range traps and slot lanes the bounds prove
+    safe while the backend carries the on-device certificate check
+    that re-verifies every claimed bound on every generated state -
+    so an unsound bound turns the verdict loud instead of silently
+    narrowing real states away.  `elide=False` narrows the codec but
+    keeps every trap and carries no certificate (the mesh-sharded
+    engines, which have no certificate column: the encode traps stay
+    the soundness story there)."""
     system = model.system
-    hints = typeok_hints(system.ev, model.invariants, system.variables)
-    var_shapes = infer_shapes(system.ev, system.variables,
-                              system.init_ast, system.next_ast,
-                              hints=hints)
+    trap_policy = None
+    cert = False
+    if bounds is not None and getattr(bounds, "certified", False):
+        var_shapes = {v: bounds.bounds[v] for v in system.variables}
+        if elide:
+            trap_policy = TrapPolicy(
+                elide_range=True,
+                card_bounds=dict(bounds.card_bounds),
+            )
+            cert = True
+    else:
+        bounds = None
+        hints = typeok_hints(system.ev, model.invariants,
+                             system.variables)
+        var_shapes = infer_shapes(system.ev, system.variables,
+                                  system.init_ast, system.next_ast,
+                                  hints=hints)
     cdc = StructCodec(system.variables, var_shapes)
-    compiler = LaneCompiler(system.ev, system.variables, var_shapes, cdc)
+    compiler = LaneCompiler(system.ev, system.variables, var_shapes,
+                            cdc, trap_policy=trap_policy)
     batch_step = compiler.build_step(system.next_ast)
     inv_fns = [
         compiler.build_invariant(ast) for ast in model.invariants.values()
@@ -67,6 +140,8 @@ def struct_backend(model: StructModel,
     lane_action = jnp.asarray(
         [action_names.index(x) for x in labels], jnp.int32
     )
+    trap_stats = (compiler.trap_sites, compiler.elided_traps,
+                  compiler.reduced_slot_lanes)
 
     def step(vec):
         succs, valid, ovf, afail = batch_step(vec[None])
@@ -82,7 +157,23 @@ def struct_backend(model: StructModel,
         inits = system.initial_states()
         return np.stack([cdc.encode(st) for st in inits])
 
-    return SpecBackend(
+    cert_check = None
+    if cert:
+        cert_check = make_cert_check(
+            cdc, _card_specs(cdc, system.variables, bounds.card_bounds)
+        )
+
+    viol_names = struct_viol_names(model)
+    if bounds is not None:
+        from ..engine.bfs import VIOL_SLOT_OVERFLOW
+
+        viol_names[VIOL_SLOT_OVERFLOW] = (
+            "Codec slot overflow / certified-bound escape (narrowed "
+            "codec: a value left the certified reachable range - "
+            "re-run with -no-narrow; if that passes, report the spec: "
+            "the bound certification is unsound)"
+        )
+    backend = SpecBackend(
         cdc=cdc,
         step=step,
         n_lanes=len(labels),
@@ -92,10 +183,14 @@ def struct_backend(model: StructModel,
         ),
         initial_vectors=initial_vectors,
         labels=action_names,
-        viol_names=struct_viol_names(model),
+        viol_names=viol_names,
         lane_action=lane_action,
         check_deadlock=check_deadlock,
+        cert_check=cert_check,
     )
+    # trap-audit surface (preflight renders which traps remain and why)
+    backend.cdc.trap_stats = trap_stats
+    return backend
 
 
 def canonical_constants(model: StructModel) -> dict:
@@ -110,15 +205,21 @@ def canonical_constants(model: StructModel) -> dict:
     return out
 
 
-def struct_meta_config(model: StructModel) -> dict:
+def struct_meta_config(model: StructModel, bounds=None) -> dict:
     """The checkpoint `config` stanza for struct runs: digest +
     canonical constants + invariant list - everything that shapes the
     compiled step, so a -recover against a different spec text or
-    overrides is a loud mismatch, never a silent misrun."""
-    return {
+    overrides is a loud mismatch, never a silent misrun.  A narrowed
+    run additionally records its bound digest: a narrowed checkpoint
+    resumed without -narrow (or with re-derived different bounds) is a
+    different carry layout and must mismatch loudly."""
+    out = {
         "frontend": "struct",
         "root": model.root_name,
         "digest": model.source_digest,
         "constants": canonical_constants(model),
         "invariants": list(model.invariants),
     }
+    if bounds is not None:
+        out["bound_digest"] = bounds.digest()
+    return out

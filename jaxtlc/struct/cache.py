@@ -94,10 +94,11 @@ _ENGINE_MEMO = _LRUMemo(_env_cap("JAXTLC_ENGINE_MEMO_CAP", 32))
 
 
 def stats() -> dict:
-    """Hit/miss/size/eviction counters for both memos (cumulative per
+    """Hit/miss/size/eviction counters for the memos (cumulative per
     process; the serve /pool endpoint republishes them)."""
     return {"backend": _BACKEND_MEMO.stats(),
-            "engine": _ENGINE_MEMO.stats()}
+            "engine": _ENGINE_MEMO.stats(),
+            "bounds": _BOUNDS_MEMO.stats()}
 
 
 def set_caps(backend: int = None, engine: int = None) -> None:
@@ -151,16 +152,51 @@ def model_key(model) -> tuple:
     )
 
 
-def get_backend(model, check_deadlock: bool = True):
+# certified bound reports are pure functions of the spec meaning
+# (digest + constants + invariants); milliseconds of host Python, but
+# the memo keeps the narrowed-engine key stable within a process
+_BOUNDS_MEMO = _LRUMemo(_env_cap("JAXTLC_BOUNDS_MEMO_CAP", 64))
+
+
+def get_bounds(model):
+    """Memoized certified bound report (analysis.absint) for a struct
+    model - every consumer of the narrowed codec (backend memo, engine
+    memo, checkpoint meta) derives its key from this one report."""
+    from ..analysis.absint import analyze_bounds
+
+    key = model_key(model)
+    hit = _BOUNDS_MEMO.get(key)
+    if hit is None:
+        hit = analyze_bounds(model)
+        _BOUNDS_MEMO.put(key, hit)
+    return hit
+
+
+def _bounds_key(bounds) -> str:
+    """The bound-digest component of narrowed cache keys ("" = the
+    un-narrowed baseline layout)."""
+    if bounds is None:
+        return ""
+    return bounds.digest()
+
+
+def get_backend(model, check_deadlock: bool = True, bounds=None,
+                elide: bool = True):
     """Memoized struct_backend (the parse -> shape-infer -> lane-compile
-    pipeline runs once per spec meaning per process)."""
+    pipeline runs once per spec meaning per process).  `bounds` (a
+    certified analysis.absint.BoundReport) selects the NARROWED
+    compile - a distinct memo entry keyed on the bound digest;
+    `elide=False` keeps every trap (the sharded engines' narrowed
+    form, which has no certificate column)."""
     from .backend import struct_backend
 
     enable_persistent_cache()
-    key = (model_key(model), bool(check_deadlock))
+    key = (model_key(model), bool(check_deadlock), _bounds_key(bounds),
+           bool(elide))
     hit = _BACKEND_MEMO.get(key)
     if hit is None:
-        hit = struct_backend(model, check_deadlock=check_deadlock)
+        hit = struct_backend(model, check_deadlock=check_deadlock,
+                             bounds=bounds, elide=elide)
         _BACKEND_MEMO.put(key, hit)
     return hit
 
@@ -176,15 +212,18 @@ def engine_key(
     check_deadlock: bool = True,
     pipeline: bool = False,
     obs_slots: int = 0,
+    bounds=None,
 ) -> tuple:
     """The full engine-memo key: spec meaning (digest + canonical
-    constants + invariants) x engine geometry x pipeline/obs flags.
+    constants + invariants) x engine geometry x pipeline/obs flags x
+    the certified-bound digest (a narrowed engine is a DIFFERENT
+    compile - its codec, lanes and traps all change with the bounds).
     The serve EnginePool keys its warm AOT entries on exactly this
     tuple so pool identity and memo identity cannot drift."""
     return (
         model_key(model), "single", chunk, queue_capacity, fp_capacity,
         fp_index, seed, fp_highwater, bool(check_deadlock),
-        bool(pipeline), int(obs_slots),
+        bool(pipeline), int(obs_slots), _bounds_key(bounds),
     )
 
 
@@ -199,23 +238,26 @@ def get_engine(
     check_deadlock: bool = True,
     pipeline: bool = False,
     obs_slots: int = 0,
+    bounds=None,
 ) -> Tuple:
     """Memoized single-device engine triple (init_fn, run_fn, step_fn)
     for a struct model; enables the persistent XLA cache as a side
     effect so the jit compiles it triggers land on disk.  obs_slots is
     part of the key: the ring changes the carry pytree, so an obs-on
-    engine is a different compile than an obs-off one."""
+    engine is a different compile than an obs-off one.  `bounds`
+    selects the narrowed engine (certificate check on, keyed on the
+    bound digest)."""
     from ..engine.bfs import make_backend_engine
 
     enable_persistent_cache()
     key = engine_key(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
-        obs_slots=obs_slots,
+        obs_slots=obs_slots, bounds=bounds,
     )
     hit = _ENGINE_MEMO.get(key)
     if hit is None:
-        backend = get_backend(model, check_deadlock)
+        backend = get_backend(model, check_deadlock, bounds=bounds)
         hit = make_backend_engine(
             backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
             fp_highwater=fp_highwater, pipeline=pipeline,
@@ -229,3 +271,4 @@ def clear() -> None:
     """Drop the in-process memos (tests; the persistent cache is files)."""
     _BACKEND_MEMO.clear()
     _ENGINE_MEMO.clear()
+    _BOUNDS_MEMO.clear()

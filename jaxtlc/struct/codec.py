@@ -177,6 +177,30 @@ class SeqNode:
         return tuple(items), pos
 
 
+def _layout_max_codes(lay, out: List[int]) -> None:
+    """Append `lay`'s per-field max legal codes to `out` (layout-walk
+    mirror of the widths concatenation in StructCodec.__init__)."""
+    if isinstance(lay, EnumLeaf):
+        out.append(len(lay.values) - 1)
+        return
+    if isinstance(lay, MaskLeaf):
+        for w in lay.widths:
+            out.append((1 << w) - 1)
+        return
+    if isinstance(lay, RecNode):
+        for _f, opt, child in lay.entries:
+            if opt:
+                out.append(1)
+            _layout_max_codes(child, out)
+        return
+    if isinstance(lay, SeqNode):
+        out.append(lay.cap)
+        for _ in range(lay.cap):
+            out.append(len(lay.elem.values) - 1)
+        return
+    raise ShapeError(f"no max codes for layout {type(lay).__name__}")
+
+
 _LAYOUT_CACHE: Dict[Shape, object] = {}
 
 
@@ -233,6 +257,18 @@ class StructCodec:
         self.n_fields = len(self.widths)
         self.nbits = sum(self.widths)
         self.n_words = (self.nbits + 31) // 32
+
+    def max_codes(self) -> List[int]:
+        """Per-field maximum LEGAL code ([F] ints): the universe claim
+        the runtime certificate check (analysis.absint) verifies on
+        every generated state.  A field can hold up to 2^width - 1
+        after packing; codes above max_codes (or below 0 pre-pack) are
+        values the certified bounds claim unreachable."""
+        out: List[int] = []
+        for lay in self.layouts:
+            _layout_max_codes(lay, out)
+        assert len(out) == self.n_fields
+        return out
 
     def encode(self, st: tuple) -> np.ndarray:
         out: List[int] = []

@@ -57,6 +57,21 @@ UNROLL_LIMIT = 12  # quantifier domains up to this size unroll in Python
 SLOT_CAP = 4  # lanes per set-valued nondeterministic pick
 
 
+class TrapPolicy:
+    """What the certified bound report (analysis.absint) lets the
+    compiler drop: range traps whose value interval is PROVEN inside
+    the destination universe, and slot lanes / slot-overflow traps on
+    set binders whose certified cardinality bound fits.  Built only
+    from a CERTIFIED BoundReport; the runtime certificate column
+    re-verifies every claim on device, so an unsound bound turns the
+    verdict loud instead of silently narrowing states away."""
+
+    def __init__(self, elide_range: bool = False,
+                 card_bounds: Optional[Dict[str, int]] = None):
+        self.elide_range = bool(elide_range)
+        self.card_bounds = dict(card_bounds or {})
+
+
 class CompileError(ValueError):
     pass
 
@@ -89,9 +104,29 @@ class LB(LV):
 
 
 class LI(LV):
-    def __init__(self, arr, depth=0):
+    """Integer lanes, optionally carrying a CERTIFIED (lo, hi) interval.
+
+    Bounds originate only where they are unconditionally true of the
+    lanes: committed-state decodes (state fields hold legal codes - the
+    encode traps enforce it, and certificate mode re-verifies it on
+    device), literals, and interval arithmetic over bounded operands.
+    Derived reads that can yield the absent code (-1) - field gathers,
+    dynamic sequence indexing - carry no bounds, so the range-trap
+    elision (analysis.absint TrapPolicy) can never fire on them."""
+
+    def __init__(self, arr, depth=0, bounds=None):
         self.arr = arr
         self.depth = depth
+        self.bounds = bounds  # Optional[(lo, hi)]
+
+
+def _int_bounds(lv) -> Optional[Tuple[int, int]]:
+    if isinstance(lv, LC):
+        v = int(lv.value)
+        return (v, v)
+    if isinstance(lv, LI):
+        return lv.bounds
+    return None
 
 
 class LE(LV):
@@ -158,11 +193,18 @@ def _binop_arrs(a_arr, a_d, b_arr, b_d):
 class LaneCompiler:
     def __init__(self, ev: Evaluator, variables: Tuple[str, ...],
                  var_shapes: Dict[str, Shape], codec: StructCodec,
-                 sweep_vars: frozenset = frozenset()):
+                 sweep_vars: frozenset = frozenset(),
+                 trap_policy: Optional[TrapPolicy] = None):
         self.ev = ev
         self.variables = variables
         self.var_shapes = var_shapes
         self.codec = codec
+        # certified-bound trap policy (None = every trap stays); the
+        # counters below feed the preflight trap-audit report
+        self.trap_policy = trap_policy
+        self.trap_sites = 0
+        self.elided_traps = 0
+        self.reduced_slot_lanes = 0
         # swept constants (jaxtlc.serve.sweep): CONSTANT names promoted
         # to read-only codec fields so their value is RUNTIME data - one
         # compiled step serves every configuration of the constants
@@ -181,9 +223,13 @@ class LaneCompiler:
         lay = layout_of(shape)
         if isinstance(lay, EnumLeaf):
             return lay
-        if isinstance(lay, MaskLeaf):
+        if isinstance(lay, (MaskLeaf, SeqNode)):
             # a set stored as a mask still has a (tiny) subset-enum leaf
-            # when nested inside an enumerated record (KubeAPI's vv)
+            # when nested inside an enumerated record (KubeAPI's vv);
+            # likewise a bounded sequence whose universe is small enough
+            # that the NARROWED record containing it enum-encodes
+            # (certified bounds can shrink a RecNode variable into one
+            # EnumLeaf - its sequence fields then gather through this)
             key = ("enum", shape)
             hit = self._field_tables.get(key)
             if hit is None:
@@ -280,6 +326,17 @@ class LaneCompiler:
         if isinstance(lv, LI):
             sh = leaf.shape
             if isinstance(sh, SInt):
+                self.trap_sites += 1
+                b = lv.bounds
+                if (self.trap_policy is not None
+                        and self.trap_policy.elide_range
+                        and b is not None
+                        and b[0] >= sh.lo and b[1] <= sh.hi):
+                    # the certified interval proves the range trap
+                    # unreachable: compile it out (the runtime
+                    # certificate column re-verifies the claim)
+                    self.elided_traps += 1
+                    return LE(lv.arr - sh.lo, leaf, lv.depth)
                 # range trap: a value outside the (widened) inferred
                 # range encodes as -1 and halts the engine loudly
                 ok = (lv.arr >= sh.lo) & (lv.arr <= sh.hi)
@@ -441,12 +498,15 @@ class LaneCompiler:
             entries.append((f, LB(pres, lv.depth), self._from_leaf(val, s)))
         return LRec(entries)
 
-    def _from_leaf(self, lv: LE, shape) -> LV:
+    def _from_leaf(self, lv: LE, shape, trusted: bool = False) -> LV:
         """Enum-decoded values regain their native lane type: ints/bools
         become arithmetic/boolean lanes, sets become masks so set
-        algebra stays bitwise after an explode."""
+        algebra stays bitwise after an explode.  `trusted` marks codes
+        that CANNOT be the absent sentinel (-1) - committed-state
+        decodes - whose int view therefore carries certified bounds."""
         if isinstance(shape, SInt):
-            return LI(lv.arr + shape.lo, lv.depth)
+            return LI(lv.arr + shape.lo, lv.depth,
+                      bounds=(shape.lo, shape.hi) if trusted else None)
         if isinstance(shape, SBool):
             return LB(lv.arr == 1, lv.depth)
         if isinstance(shape, SSet):
@@ -457,6 +517,33 @@ class LaneCompiler:
             # the value's index IS the subset bit pattern (codec order)
             bits = (safe[..., None] // weights) % 2 == 1
             return LM(bits, elem_leaf, lv.depth)
+        if isinstance(shape, SSeq) and isinstance(lv.leaf.shape, SSeq):
+            # enum-coded bounded sequence (a seq field gathered out of
+            # an enum-encoded record) -> structural LSeq via length /
+            # slot gather tables, so Len/Head/Tail/indexing keep
+            # working after the narrowed layout enum-encodes the parent
+            elem_leaf = self._leaf_of_shape(shape.elem)
+            key = (id(lv.leaf), "#seq", id(elem_leaf))
+            tabs = self._pred_tables.get(key)
+            if tabs is None:
+                lens, slots = [], [[] for _ in range(shape.cap)]
+                for v in lv.leaf.values:
+                    t = v if isinstance(v, tuple) else ()
+                    lens.append(len(t))
+                    for k in range(shape.cap):
+                        slots[k].append(
+                            elem_leaf.index.get(t[k], 0)
+                            if k < len(t) else 0
+                        )
+                tabs = (np.asarray(lens, np.int32),
+                        [np.asarray(s, np.int32) for s in slots])
+                self._pred_tables[key] = tabs
+            safe = jnp.maximum(lv.arr, 0)
+            length = LI(jnp.asarray(tabs[0])[safe], lv.depth,
+                        bounds=(0, shape.cap))
+            slot_lvs = [LE(jnp.asarray(t)[safe], elem_leaf, lv.depth)
+                        for t in tabs[1]]
+            return LSeq(length, slot_lvs, elem_leaf, shape.cap)
         return lv
 
     # -- equality ----------------------------------------------------------
@@ -1090,7 +1177,19 @@ class LaneCompiler:
                 int(b.value))[None]
             x, y, d = _binop_arrs(av, getattr(a, "depth", 0),
                                   bv, getattr(b, "depth", 0))
-            return LI({"+": x + y, "-": x - y, "*": x * y}[sym], d)
+            ba, bb = _int_bounds(a), _int_bounds(b)
+            nb = None
+            if ba is not None and bb is not None:
+                if sym == "+":
+                    nb = (ba[0] + bb[0], ba[1] + bb[1])
+                elif sym == "-":
+                    nb = (ba[0] - bb[1], ba[1] - bb[0])
+                else:
+                    cs = [ba[0] * bb[0], ba[0] * bb[1],
+                          ba[1] * bb[0], ba[1] * bb[1]]
+                    nb = (min(cs), max(cs))
+            return LI({"+": x + y, "-": x - y, "*": x * y}[sym], d,
+                      bounds=nb)
         if sym == "..":
             if isinstance(a, LC) and isinstance(b, LC):
                 return LC(frozenset(range(a.value, b.value + 1)))
@@ -1262,7 +1361,10 @@ class LaneCompiler:
                                    bb, getattr(b, "depth", 0))
             carr, x2, d = _binop_arrs(c.arr, c.depth, x, d0)
             _, y2, _ = _binop_arrs(carr, d, y, d0)
-            return LI(jnp.where(carr, x2, y2), d)
+            ba, bb2 = _int_bounds(a), _int_bounds(b)
+            hull = (min(ba[0], bb2[0]), max(ba[1], bb2[1])) \
+                if ba is not None and bb2 is not None else None
+            return LI(jnp.where(carr, x2, y2), d, bounds=hull)
         # enum path: unify through a leaf
         leaf = None
         if isinstance(a, LE):
@@ -1529,7 +1631,8 @@ class LaneCompiler:
             if isinstance(s, LC):
                 return LC(len(s.value))
             m = self.as_mask(s)
-            return LI(m.bits.sum(axis=-1).astype(jnp.int32), m.depth)
+            return LI(m.bits.sum(axis=-1).astype(jnp.int32), m.depth,
+                      bounds=(0, len(m.elem_leaf.values)))
         if name == "Len":
             (s,) = vals
             if isinstance(s, LSeq):
@@ -1543,7 +1646,11 @@ class LaneCompiler:
         if name == "Tail":
             (s,) = vals
             if isinstance(s, LSeq):
-                ln = LI(jnp.maximum(s.length.arr - 1, 0), s.length.depth)
+                lb = s.length.bounds
+                ln = LI(jnp.maximum(s.length.arr - 1, 0),
+                        s.length.depth,
+                        bounds=(max(lb[0] - 1, 0), max(lb[1] - 1, 0))
+                        if lb is not None else None)
                 zero = LE(jnp.zeros((1,), jnp.int32), s.leaf, 0)
                 return LSeq(ln, s.slots[1:] + [zero], s.leaf, s.cap)
             raise CompileError("Tail of non-sequence")
@@ -1558,7 +1665,10 @@ class LaneCompiler:
             for i in range(s.cap):
                 at_i = LB(s.length.arr == i, s.length.depth)
                 slots.append(self.select(at_i, ee, s.slots[i]))
-            return LSeq(LI(s.length.arr + 1, s.length.depth), slots,
+            lb = s.length.bounds
+            return LSeq(LI(s.length.arr + 1, s.length.depth,
+                           bounds=(lb[0] + 1, lb[1] + 1)
+                           if lb is not None else None), slots,
                         s.leaf, s.cap)
         if name == "Assert":
             cond, _msg = vals
@@ -1600,7 +1710,10 @@ class LaneCompiler:
     def _decode_layout(self, lay, fields, pos, shape):
         if isinstance(lay, EnumLeaf):
             lv = LE(fields[:, pos], lay, 0)
-            return self._from_leaf(lv, shape), pos + 1
+            # committed-state fields hold legal codes (encode traps
+            # enforce it; certificate mode re-verifies on device), so
+            # the decoded int view carries certified bounds
+            return self._from_leaf(lv, shape, trusted=True), pos + 1
         if isinstance(lay, MaskLeaf):
             cols = []
             for gi, w in enumerate(lay.widths):
@@ -1623,7 +1736,7 @@ class LaneCompiler:
                 entries.append((f, pres, val))
             return LRec(entries), pos
         if isinstance(lay, SeqNode):
-            length = LI(fields[:, pos], 0)
+            length = LI(fields[:, pos], 0, bounds=(0, lay.cap))
             pos += 1
             slots = []
             for _ in range(lay.cap):
@@ -1814,7 +1927,50 @@ class LaneCompiler:
                 raise CompileError("guard is not BOOLEAN")
             return
         ctx.guard = self._land(ctx.guard, g)
-        self._walk_seq(rest, 0, env, ctx, label, out)
+        self._walk_seq(rest, 0, self._refine_guard_env(ast, env), ctx,
+                       label, out)
+
+    def _refine_guard_env(self, ast, env):
+        """Bare-variable interval refinement under a lane guard: after
+        `x < N` joins the lane guard, x's certified interval within
+        THIS lane meets the comparison (sound for trap elision: the
+        elided trap is ANDed with the lane's validity, which includes
+        exactly this guard - build_step's `ovf & valid`)."""
+        if not (isinstance(ast, tuple) and len(ast) == 4
+                and ast[0] == "cmp"):
+            return env
+        _, sym, la, ra = ast
+        for lhs, rhs, s in ((la, ra, sym),
+                            (ra, la, {"<": ">", ">": "<", "<=": ">=",
+                                      ">=": "<="}.get(sym, sym))):
+            if not (isinstance(lhs, tuple) and lhs[0] == "name"):
+                continue
+            lv = env.get(lhs[1])
+            if not isinstance(lv, LI) or lv.bounds is None:
+                continue
+            try:
+                rb = _int_bounds(self.comp(rhs, env, LaneCtx()))
+            except (ValueError, KeyError, TypeError):
+                continue
+            if rb is None:
+                continue
+            lo, hi = lv.bounds
+            if s == "<":
+                hi = min(hi, rb[1] - 1)
+            elif s == "<=":
+                hi = min(hi, rb[1])
+            elif s == ">":
+                lo = max(lo, rb[0] + 1)
+            elif s == ">=":
+                lo = max(lo, rb[0])
+            elif s == "=":
+                lo, hi = max(lo, rb[0]), min(hi, rb[1])
+            else:
+                continue
+            if lo <= hi:
+                env = dict(env)
+                env[lhs[1]] = LI(lv.arr, lv.depth, bounds=(lo, hi))
+        return env
 
     def _walk_exists(self, ast, rest, env, ctx, label, out):
         _, names, dom_ast, body = ast
@@ -1840,10 +1996,27 @@ class LaneCompiler:
                 c2.guard = self._land(c2.guard, LB(m.bits[..., i], 0))
                 self._walk_seq([body] + rest, 0, env2, c2, label, out)
             return
-        # record-universe set: k-th set-bit slot lanes
+        # record-universe set: k-th set-bit slot lanes.  A certified
+        # cardinality bound on a bare-variable domain (analysis.absint
+        # TrapPolicy) shrinks the lane fan to the bound and - when the
+        # bound fits the slot budget - elides the overflow trap: lanes
+        # k >= |set| are never valid, so dropping them is count-exact,
+        # and the runtime certificate column re-verifies the bound
+        # (popcount of the committed mask) on device
+        slot_cap = SLOT_CAP
+        card = None
+        if self.trap_policy is not None and dom_ast[0] == "name":
+            card = self.trap_policy.card_bounds.get(dom_ast[1])
+        if card is not None and card < SLOT_CAP:
+            self.reduced_slot_lanes += SLOT_CAP - card
+            slot_cap = max(card, 1)
         counts = m.bits.astype(jnp.int32).cumsum(axis=-1)
         total = counts[..., -1]
-        for k in range(SLOT_CAP):
+        self.trap_sites += 1
+        proven = card is not None and card <= slot_cap
+        if proven:
+            self.elided_traps += 1
+        for k in range(slot_cap):
             sel = m.bits & (counts == k + 1)
             idx = jnp.argmax(sel, axis=-1).astype(jnp.int32)
             has = sel.any(axis=-1)
@@ -1854,7 +2027,8 @@ class LaneCompiler:
             )
             c2 = ctx.fork()
             c2.guard = self._land(c2.guard, LB(has, 0))
-            c2.ovf = self._lor(c2.ovf, LB(total > SLOT_CAP, 0))
+            if not proven:
+                c2.ovf = self._lor(c2.ovf, LB(total > slot_cap, 0))
             self._walk_seq([body] + rest, 0, env2, c2, label, out)
 
     # ======================================================================
@@ -1869,6 +2043,11 @@ class LaneCompiler:
 
         def step(fields):
             B = fields.shape[0]
+            # trap accounting restarts per trace so retraces (eval_shape
+            # then jit) report one compile's numbers, not a running sum
+            self.trap_sites = 0
+            self.elided_traps = 0
+            self.reduced_slot_lanes = 0
             env0 = dict(self.decode_state(fields))
             lanes = self.walk_lanes(next_ast, env0)
             labels = []

@@ -53,15 +53,18 @@ def check_struct(
     fp_highwater: float = 0.85,
     pipeline: bool = False,
     obs_slots: int = 0,
+    bounds=None,
 ) -> CheckResult:
     """Exhaustive device check of a struct-compiled spec (single device,
-    fused loop; AOT-compiled before timing like bfs.check)."""
+    fused loop; AOT-compiled before timing like bfs.check).  `bounds`
+    (a certified analysis.absint.BoundReport) runs the NARROWED engine
+    with the runtime certificate check on."""
     init_fn, run_fn, _ = get_engine(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
-        obs_slots=obs_slots,
+        obs_slots=obs_slots, bounds=bounds,
     )
-    backend = get_backend(model, check_deadlock)
+    backend = get_backend(model, check_deadlock, bounds=bounds)
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()
     t0 = time.time()
@@ -69,7 +72,7 @@ def check_struct(
     wall = time.time() - t0
     return result_from_carry(
         out, wall, fp_capacity=fp_capacity, labels=backend.labels,
-        viol_names=struct_viol_names(model),
+        viol_names=backend.viol_names,
     )
 
 
@@ -83,13 +86,18 @@ def check_struct_sharded(
     check_deadlock: bool = True,
     pipeline: bool = False,
     obs_slots: int = 0,
+    bounds=None,
 ) -> CheckResult:
     """Exhaustive mesh-sharded check of a struct-compiled spec
     (capacities PER DEVICE; fingerprint-space all_to_all partitioning,
-    psum-reduced counters - engine.sharded, same backend seam)."""
+    psum-reduced counters - engine.sharded, same backend seam).
+    `bounds` narrows the codec; the mesh engine has no certificate
+    column yet, so every trap stays compiled in (elide=False) and the
+    encode traps carry the soundness story there."""
     from ..engine.sharded import check_sharded
 
-    backend = get_backend(model, check_deadlock)
+    backend = get_backend(model, check_deadlock, bounds=bounds,
+                          elide=False)
     return check_sharded(
         None, mesh, chunk=chunk, queue_capacity=queue_capacity,
         fp_capacity=fp_capacity, route_factor=route_factor,

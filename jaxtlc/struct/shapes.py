@@ -317,6 +317,12 @@ def enumerable(shape: Optional[Shape], limit: int = ENUM_LIMIT) -> bool:
 class ShapeInference:
     """Infers per-variable shapes from Init + all primed updates."""
 
+    # abstract values for CONSTANT names, consulted before the concrete
+    # ev.constants: the sweep-class audit (jaxtlc.analysis) widens a
+    # swept constant to its whole lo..hi interval here, so one abstract
+    # pass covers every configuration of the class
+    const_hints: Dict[str, Shape] = {}
+
     def __init__(self, ev: Evaluator, variables: Tuple[str, ...],
                  init_ast, next_ast):
         self.ev = ev
@@ -418,9 +424,15 @@ class ShapeInference:
             rhs = self._abstract(ast[3], env)
             if ast[1] == r"\in":
                 rhs = self._elem_shape(rhs)
-            self.var_shapes[name] = join(self.var_shapes[name], rhs)
+            self._record_write(name, rhs)
             return
         # guards / UNCHANGED contribute nothing
+
+    def _record_write(self, name: str, sh: Optional[Shape]) -> None:
+        """One primed assignment observed; the abstract-interpretation
+        subclass (analysis.absint) collects writes separately to run
+        descending (narrowing) iterations."""
+        self.var_shapes[name] = join(self.var_shapes[name], sh)
 
     # -- abstract expression evaluation ------------------------------------
 
@@ -447,6 +459,8 @@ class ShapeInference:
             nm = ast[1]
             if nm in env and not isinstance(env[nm], Definition):
                 return env[nm]
+            if nm in self.const_hints:
+                return self.const_hints[nm]
             if nm in self.ev.constants:
                 return shape_of_value(self.ev.constants[nm])
             if nm in BUILTIN_SETS:
@@ -961,9 +975,67 @@ def _clamp(sh: Optional[Shape], hint: Optional[Shape]) -> Optional[Shape]:
     return sh
 
 
+def shape_leq(a: Optional[Shape], b: Optional[Shape]) -> bool:
+    """Abstract-domain containment: every concrete value of `a` is a
+    value of `b`.  Conservative (False on anything unproven) - this is
+    the check that CERTIFIES a narrowed bound environment as a
+    post-fixpoint (analysis.absint), so an unprovable containment must
+    fail closed."""
+    if a is None:
+        return True  # bottom
+    if b is None:
+        return False
+    if a == b:
+        return True
+    # the empty container coerces across container classes (see join)
+    if a == SSeq(None, 0) and isinstance(b, (SFun, SRec, SSeq)):
+        return True
+    if isinstance(b, SUnion):
+        alts = a.alts if isinstance(a, SUnion) else (a,)
+        return all(any(shape_leq(x, alt) for alt in b.alts)
+                   for x in alts)
+    if isinstance(a, SUnion):
+        return all(shape_leq(x, b) for x in a.alts)
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, SBool):
+        return True
+    if isinstance(a, SInt):
+        return b.lo <= a.lo and a.hi <= b.hi
+    if isinstance(a, SAtoms):
+        return a.atoms <= b.atoms
+    if isinstance(a, SRec):
+        bf = {f: (s, o) for f, s, o in b.fields}
+        for f, s, o in a.fields:
+            if f not in bf:
+                return False
+            bs, bo = bf[f]
+            if o and not bo:
+                return False  # a may omit the field; b cannot
+            if not shape_leq(s, bs):
+                return False
+        # fields of b absent from a must be omittable in b
+        anames = {f for f, _, _ in a.fields}
+        return all(o for f, _, o in b.fields if f not in anames)
+    if isinstance(a, SSet):
+        return shape_leq(a.elem, b.elem)
+    if isinstance(a, SSeq):
+        return a.cap <= b.cap and shape_leq(a.elem, b.elem)
+    if isinstance(a, SFun):
+        if not set(a.keys) <= set(b.keys):
+            return False
+        if not b.partial and (a.partial or set(a.keys) != set(b.keys)):
+            return False
+        return shape_leq(a.val, b.val)
+    return False
+
+
 def infer_shapes(ev: Evaluator, variables, init_ast, next_ast,
-                 hints: Optional[Dict[str, Shape]] = None
+                 hints: Optional[Dict[str, Shape]] = None,
+                 const_hints: Optional[Dict[str, Shape]] = None
                  ) -> Dict[str, Shape]:
     inf = ShapeInference(ev, variables, init_ast, next_ast)
     inf.hints = hints or {}
+    if const_hints:
+        inf.const_hints = dict(const_hints)
     return inf.run()

@@ -541,8 +541,8 @@ def test_selfcheck_registry_pinned():
     from jaxtlc.analysis.selfcheck import FACTORIES
 
     assert sorted(FACTORIES) == [
-        "enumerator", "fused", "phased", "pipelined", "sharded",
-        "spill", "struct", "sweep",
+        "enumerator", "fused", "narrowed", "phased", "pipelined",
+        "sharded", "spill", "struct", "sweep",
     ]
 
 
@@ -559,7 +559,7 @@ def test_selfcheck_tiny_smoke():
     out = buf.getvalue()
     assert rc == 0, out
     for name in ("fused", "pipelined", "sharded", "spill", "struct",
-                 "enumerator"):
+                 "narrowed", "enumerator"):
         assert f"audit {name}: ok" in out, out
 
 

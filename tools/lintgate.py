@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Engine-free lint gate over the specs tree (CI entry point).
+
+    python tools/lintgate.py [SPECS_DIR]
+
+Runs speclint + the certified abstract interpretation over every
+MC.cfg under SPECS_DIR (default: the repo's specs/), printing one line
+per spec plus its findings, and exits nonzero on any error-severity
+finding.  Milliseconds per spec - no jax import, no engine build - so
+it belongs in front of every commit touching specs/.  The same pass
+runs as ``python -m jaxtlc.analysis --gate`` and as a tier-1 test
+(tests/test_absint.py), so the committed tree can never drift into an
+error-class lint silently.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "specs",
+    )
+    from jaxtlc.analysis.gate import run_gate
+
+    return run_gate(root)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
