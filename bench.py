@@ -37,6 +37,12 @@ Usage:
                                # ring on vs off: obs_overhead_pct metric
                                # line, full-signature bit-equality gated
                                # (the <= 2% acceptance gate of ISSUE 5)
+    python bench.py --cov-ab   # Model_1 with the device coverage plane
+                               # on vs off (obs ring on both sides):
+                               # coverage_overhead_pct metric line,
+                               # full-signature bit-equality gated
+                               # (the <= 0.5% acceptance gate of
+                               # ISSUE 11)
 """
 
 import json
@@ -670,9 +676,144 @@ def bench_obs_ab(probe_err: str) -> int:
     return 0
 
 
+def bench_cov_ab(probe_err: str) -> int:
+    """--cov-ab: measure the cost of the device coverage plane.
+
+    The ISSUE 11 acceptance A/B, run with the round-8/11 methodology:
+    both engines (the 311-site KubeAPI coverage plane ON vs OFF, obs
+    ring 256 on both sides so only the coverage tensor differs) are
+    AOT-compiled once and the timed runs INTERLEAVE best-of-5.  The
+    coverage-on run must be bit-for-bit the coverage-off run (full
+    signature + fpset TABLE word equality - the plane is telemetry,
+    not a participant), its tracked per-action sites must equal the
+    engine's own generated counters, and the emitted
+    `coverage_overhead_pct` gates at <= 0.5%."""
+    device_note = ""
+    if probe_err:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        device_note = f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
+    import jax
+    import numpy as np
+
+    from jaxtlc.config import MODEL_1
+    from jaxtlc.engine.backend import kubeapi_backend
+    from jaxtlc.engine.bfs import make_backend_engine, result_from_carry
+
+    workload = "Model_1"
+    kw = dict(chunk=1024, queue_capacity=1 << 15, fp_capacity=1 << 20)
+    compiled = {}
+    planes = {}
+    for cov in (False, True):
+        backend = kubeapi_backend(MODEL_1, coverage=cov)
+        planes[cov] = backend.coverage
+        init_fn, run_fn, _ = make_backend_engine(
+            backend, **kw, obs_slots=256, donate=False,
+        )
+        carry0 = init_fn()
+        compiled[cov] = (run_fn.lower(carry0).compile(), carry0)
+
+    walls = {False: [], True: []}
+    finals = {}
+    for _ in range(5):
+        for cov in (False, True):
+            fn, carry0 = compiled[cov]
+            t0 = time.time()
+            out = jax.block_until_ready(fn(carry0))
+            walls[cov].append(time.time() - t0)
+            finals[cov] = out
+
+    results = {}
+    for cov, out in finals.items():
+        r = result_from_carry(
+            out, min(walls[cov]), fp_capacity=kw["fp_capacity"],
+            sites=planes[cov].sites if planes[cov] else None,
+        )
+        if r.violation or (
+            r.generated, r.distinct, r.depth
+        ) != EXPECT[workload]:
+            _emit({"error": f"coverage={cov} count mismatch: "
+                            f"{(r.generated, r.distinct, r.depth)}",
+                   "workload": workload})
+            return 1
+        results[cov] = r
+
+    def signature(r):
+        return (r.generated, r.distinct, r.depth, r.violation,
+                tuple(sorted(r.action_generated.items())),
+                tuple(sorted(r.action_distinct.items())),
+                r.outdegree, r.fp_occupancy)
+
+    if signature(results[False]) != signature(results[True]) or not (
+        np.asarray(finals[False].fps.table)
+        == np.asarray(finals[True].fps.table)
+    ).all():
+        _emit({"error": "coverage-on run is not bit-identical to the "
+                        "coverage-off engine", "workload": workload})
+        return 1
+    # the action-prefix sites are the engine's own generated counters
+    cov_tab = results[True].site_coverage
+    for name, g in results[True].action_generated.items():
+        if cov_tab.get(name, 0) != g:
+            _emit({"error": f"coverage action site {name} "
+                            f"{cov_tab.get(name, 0)} != generated {g}",
+                   "workload": workload})
+            return 1
+
+    wall_off, wall_on = min(walls[False]), min(walls[True])
+    overhead_pct = round((wall_on - wall_off) / wall_off * 100, 3)
+    device = str(jax.devices()[0]) + device_note
+    on_cpu = jax.devices()[0].platform == "cpu"
+    rate = results[True].distinct / wall_on
+    visited = sum(1 for v in cov_tab.values() if v)
+    # the 0.5% wall gate is an ON-CHIP acceptance: XLA's CPU backend
+    # pays per-op dispatch for the ~1.4k-op site hook (~1 ms/block
+    # against a ~3.5 ms CPU step - PERF.md round 14), a floor that
+    # fusion removes on the TPU.  On the CPU fallback the number is
+    # reported honestly and only the bit-equality gates are fatal;
+    # on-chip the wall gate enforces (standing tunnel-caveat item).
+    gate_ok = bool(overhead_pct <= 0.5)
+    _emit(
+        {
+            "metric": "coverage_overhead_pct",
+            "value": overhead_pct,
+            "unit": "%",
+            "vs_baseline": 0,
+            "workload": workload,
+            "wall_coverage_off_s": round(wall_off, 3),
+            "wall_coverage_on_s": round(wall_on, 3),
+            "sites": len(cov_tab),
+            "sites_visited": visited,
+            "gate": "<=0.5% on-chip (CPU fallback: report-only, "
+                    "per-op dispatch floor - PERF round 14)",
+            "gate_ok": gate_ok,
+            "device": device,
+        }
+    )
+    _emit(
+        {
+            "metric": "distinct_states_per_s",
+            "value": round(rate),
+            "unit": "states/s",
+            "vs_baseline": round(rate / TLC_DISTINCT_PER_S, 2),
+            "workload": workload,
+            "generated": results[True].generated,
+            "distinct": results[True].distinct,
+            "depth": results[True].depth,
+            "wall_s": round(wall_on, 3),
+            "coverage": True,
+            "device": device,
+        }
+    )
+    return 0 if (gate_ok or on_cpu) else 1
+
+
 def main() -> int:
     device_note = ""
     probe_err = _probe_backend()
+    if "--cov-ab" in sys.argv:
+        return bench_cov_ab(probe_err)
     if "--obs-ab" in sys.argv:
         return bench_obs_ab(probe_err)
     if "--pipeline-ab" in sys.argv:

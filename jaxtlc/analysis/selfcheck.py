@@ -119,6 +119,31 @@ def _build_narrowed():
                 n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
 
 
+def _build_covered():
+    # the device coverage plane engine (ISSUE 11): the same TwoPhase
+    # model as "struct" but compiled with the per-site coverage
+    # counters + the obs ring - the covered carry layout (cov_counts
+    # leaf) cannot ship unaudited
+    import os
+
+    from ..engine.bfs import make_backend_engine
+    from ..struct.cache import get_backend
+    from ..struct.loader import load
+
+    d = _specs_dir()
+    if d is None:
+        raise FileNotFoundError("specs/ directory not found")
+    model = load(os.path.join(d, "TwoPhase.toolbox", "Model_1",
+                              "MC.cfg"))
+    b = get_backend(model, True, coverage=True)
+    assert b.coverage is not None, "covered factory must carry a plane"
+    init_fn, run_fn, step_fn = make_backend_engine(
+        b, donate=False, obs_slots=8, **_TINY
+    )
+    return dict(init_fn=init_fn, run_fn=run_fn, step_fn=step_fn,
+                n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
+
+
 def _build_enumerator():
     from ..engine.bfs import make_enumerator
 
@@ -227,6 +252,7 @@ def _build_phased():
 # every shipped engine factory; audited by the self-check and pinned
 # by tier-1 so a new engine path cannot ship unaudited
 FACTORIES: Dict[str, Callable[[], dict]] = {
+    "covered": _build_covered,
     "fused": _build_fused,
     "narrowed": _build_narrowed,
     "phased": _build_phased,

@@ -395,9 +395,12 @@ def _dispatch_check(args, spec, log):
                 route_factor=args.routefactor,
                 pipeline=args.pipeline,
                 obs_slots=_obs_slots(args),
+                coverage=args.coverage,
                 opts=_sup_opts(args, log),
             )
             return sup.result, sup
+        from .engine.backend import kubeapi_backend
+
         return check_sharded(
             spec.model,
             mesh,
@@ -405,6 +408,8 @@ def _dispatch_check(args, spec, log):
             queue_capacity=args.qcap,
             fp_capacity=args.fpcap,
             route_factor=args.routefactor,
+            backend=kubeapi_backend(spec.model,
+                                    coverage=args.coverage),
             pipeline=args.pipeline,
             obs_slots=_obs_slots(args),
         ), None
@@ -442,6 +447,7 @@ def _dispatch_check(args, spec, log):
             fp_index=spec.fp_index,
             pipeline=args.pipeline,
             obs_slots=_obs_slots(args),
+            coverage=args.coverage,
             opts=_sup_opts(args, log),
         )
         return sup.result, sup
@@ -455,6 +461,7 @@ def _dispatch_check(args, spec, log):
         fp_index=spec.fp_index,
         pipeline=args.pipeline,
         obs_slots=_obs_slots(args),
+        coverage=args.coverage,
     ), None
 
 
@@ -649,6 +656,8 @@ def _resume_command(args) -> str:
         parts += ["-pipeline"]  # checkpoints only resume in the same mode
     if getattr(args, "narrow", False):
         parts += ["-narrow"]  # the narrowed codec is a different layout
+    if getattr(args, "coverage", False):
+        parts += ["-coverage"]  # the covered carry is a different layout
     if args.frontend != "auto":
         parts += ["-frontend", args.frontend]
     if not args.checkpoint:
@@ -864,6 +873,7 @@ def _run_check_struct(args, spec) -> int:
     def check():
         log = log_holder[0]
         ckd = spec.check_deadlock
+        cov = args.coverage
         kw = dict(chunk=args.chunk, queue_capacity=args.qcap,
                   fp_capacity=args.fpcap)
         if args.sharded:
@@ -878,7 +888,7 @@ def _run_check_struct(args, spec) -> int:
                 sup = check_sharded_supervised(
                     None, mesh,
                     backend=get_backend(sm, ckd, bounds=bounds,
-                                        elide=False),
+                                        elide=False, coverage=cov),
                     meta_config=struct_meta_config(sm, bounds=bounds),
                     route_factor=args.routefactor,
                     pipeline=args.pipeline,
@@ -889,14 +899,16 @@ def _run_check_struct(args, spec) -> int:
             return check_struct_sharded(
                 sm, mesh, route_factor=args.routefactor,
                 check_deadlock=ckd, pipeline=args.pipeline,
-                obs_slots=_obs_slots(args), bounds=bounds, **kw,
+                obs_slots=_obs_slots(args), bounds=bounds,
+                coverage=cov, **kw,
             ), None
         if args.checkpoint or args.autogrow:
             from .resil import check_supervised
 
             sup = check_supervised(
                 None, fp_index=spec.fp_index,
-                backend=get_backend(sm, ckd, bounds=bounds),
+                backend=get_backend(sm, ckd, bounds=bounds,
+                                    coverage=cov),
                 meta_config=struct_meta_config(sm, bounds=bounds),
                 check_deadlock=ckd,
                 pipeline=args.pipeline,
@@ -907,7 +919,7 @@ def _run_check_struct(args, spec) -> int:
         return check_struct(
             sm, fp_index=spec.fp_index, check_deadlock=ckd,
             pipeline=args.pipeline, obs_slots=_obs_slots(args),
-            bounds=bounds, **kw,
+            bounds=bounds, coverage=cov, **kw,
         ), None
 
     def props():
@@ -926,6 +938,29 @@ def _run_check_struct(args, spec) -> int:
         names = set(get_backend(sm, spec.check_deadlock).labels)
         ordered = [n for n in sm.module.def_order if n in names]
         return ordered + [n for n in sorted(names) if n not in ordered]
+
+    def coverage_device(r, n_init):
+        # the device coverage plane's end-of-run dump (MC.out format):
+        # counts straight off the carry - no host re-walk
+        if getattr(r, "site_coverage", None) is None:
+            return None
+        from .obs.coverage import render_site_dump
+
+        plane = get_backend(sm, spec.check_deadlock, bounds=bounds,
+                            coverage=True).coverage
+        counts = [r.site_coverage.get(s.key, 0) for s in plane.sites]
+        return render_site_dump(
+            plane.sites, counts, plane.module or spec.spec_name,
+            time.strftime("%Y-%m-%d %H:%M:%S"), init_count=n_init,
+            act_gen=r.action_generated, act_dist=r.action_distinct,
+            order=action_order(),  # module-definition (MC.out) order
+        )
+
+    def dead_site_lint(r):
+        # zero-visit sites cross-checked against the static
+        # unreachable-action lint: a statically-REACHABLE site that
+        # never fired is the dynamic counterpart of the PR 6 lint
+        return _struct_dead_sites(args, spec, sm, bounds, r)
 
     kit = _InterpKit(
         kind="structural",
@@ -949,8 +984,59 @@ def _run_check_struct(args, spec) -> int:
         ),
         action_order=action_order,
         preflight=lambda deep: _struct_preflight(args, spec, sm, deep),
+        coverage_device=coverage_device,
+        dead_site_lint=dead_site_lint,
     )
     return _run_check_interp(args, spec, kit, log_holder=log_holder)
+
+
+def _struct_dead_sites(args, spec, sm, bounds, r):
+    """The dead-site lint closure (ISSUE 11 satellite): at final
+    verdict, sites with zero visits are cross-checked against
+    speclint's unreachable-action findings - a statically-REACHABLE
+    site that never fired becomes a warning-severity `analysis`
+    journal event (the end-of-run dynamic counterpart of the PR 6
+    static lint).  Returns the (layer, check, severity, subject,
+    detail) event dicts; the interp runner journals + renders them."""
+    if getattr(r, "site_coverage", None) is None:
+        return []
+    from .analysis.speclint import analyze_spec
+    from .obs.coverage import zero_sites
+    from .struct.cache import get_backend
+
+    plane = get_backend(sm, spec.check_deadlock, bounds=bounds,
+                        coverage=True).coverage
+    counts = [r.site_coverage.get(s.key, 0) for s in plane.sites]
+    dead = zero_sites(plane.sites, counts)
+    if not dead:
+        return []
+    try:
+        static_dead = {
+            f.subject for f in analyze_spec(sm).findings
+            if f.check == "unreachable-action"
+        }
+    except Exception:  # a broken lint must never block the verdict
+        static_dead = set()
+    events = []
+    reachable_dead = [s for s in dead if s.action not in static_dead]
+    for s in reachable_dead[:20]:
+        what = s.loc or s.kind
+        events.append(dict(
+            layer="spec", check="dead-site", severity="warning",
+            subject=s.key,
+            detail=(f"site never fired in this run ({s.action}: {what})"
+                    " although the action is statically reachable; the"
+                    " configuration may be too small to exercise it"),
+        ))
+    if len(reachable_dead) > 20:
+        events.append(dict(
+            layer="spec", check="dead-site", severity="warning",
+            subject=sm.root_name,
+            detail=(f"{len(reachable_dead) - 20} further zero-visit "
+                    "sites suppressed (see /coverage for the full "
+                    "table)"),
+        ))
+    return events
 
 
 def _struct_preflight(args, spec, sm, deep):
@@ -986,7 +1072,8 @@ class _InterpKit:
     def __init__(self, kind, extra_unsupported, check, init_count,
                  properties, check_leads_to, fairness_label,
                  state_to_tla, state_env, violation_trace,
-                 coverage=None, action_order=None, preflight=None):
+                 coverage=None, action_order=None, preflight=None,
+                 coverage_device=None, dead_site_lint=None):
         self.kind = kind
         self.extra_unsupported = extra_unsupported
         self.check = check  # () -> (CheckResult, SupervisedResult | None)
@@ -1000,6 +1087,10 @@ class _InterpKit:
         self.coverage = coverage  # () -> dump lines, or None
         self.action_order = action_order  # () -> coverage line order
         self.preflight = preflight  # (deep) -> AnalysisReport, or None
+        # (r, n_init) -> device site-dump lines | None (obs.coverage)
+        self.coverage_device = coverage_device
+        # (r) -> analysis-event dicts for zero-visit reachable sites
+        self.dead_site_lint = dead_site_lint
 
 
 def _run_check_interp(args, spec, kit: "_InterpKit",
@@ -1176,7 +1267,42 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
         log.success(r.generated, r.distinct,
                     getattr(r, "actual_fp_collision", None),
                     occupancy=getattr(r, "fp_occupancy", None))
-        if args.coverage and kit.coverage is not None:
+        dev_lines = None
+        if args.coverage and kit.coverage_device is not None:
+            dev_lines = kit.coverage_device(r, n_init)
+        if dev_lines is not None:
+            # the DEVICE per-site dump (MC.out format): counts came off
+            # the carry live - no host re-walk (ISSUE 11)
+            log.coverage_site_dump(dev_lines)
+            j = getattr(args, "_journal", None)
+            if j is not None and not any(
+                e["event"] == "coverage" for e in j.events
+            ):
+                # unsupervised (raw-engine) runs have no segment
+                # fences: journal the cumulative table once so the
+                # serve plane / covdiff see this run's coverage too
+                j.event(
+                    "coverage",
+                    visited=sum(1 for v in r.site_coverage.values()
+                                if v),
+                    sites=len(r.site_coverage),
+                    delta={k: v for k, v in r.site_coverage.items()
+                           if v},
+                )
+            if kit.dead_site_lint is not None:
+                from .obs.views import render_tlc_event
+
+                j = getattr(args, "_journal", None)
+                for info in kit.dead_site_lint(r):
+                    if j is not None:
+                        ev = j.event("analysis", **info)
+                    else:
+                        from .obs.schema import SCHEMA_VERSION
+
+                        ev = {"v": SCHEMA_VERSION, "t": time.time(),
+                              "event": "analysis", **info}
+                    render_tlc_event(log, ev)
+        elif args.coverage and kit.coverage is not None:
             # full per-expression dump: host re-walk with instrumented
             # evaluation, the KubeAPI path's discipline applied to the
             # generic frontend (slow for large configs, like TLC's own

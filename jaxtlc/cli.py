@@ -242,8 +242,12 @@ def main(argv=None) -> int:
                         "severity findings otherwise abort the run "
                         "with a nonzero exit)")
     c.add_argument("-coverage", action="store_true",
-                   help="emit the full per-expression coverage dump "
-                        "(TLC coverage mode; re-walks the space host-side)")
+                   help="compile per-site coverage counters into the "
+                        "kernels (live `coverage` journal events, "
+                        "GET /coverage + Prometheus coverage_site_total "
+                        "on -serve, MC.out-format end-of-run dump; the "
+                        "KubeAPI path additionally renders the full "
+                        "host-walker dump for exact MC.out parity)")
     c.add_argument("-liveness", action="store_true",
                    help="check the declared temporal properties even when "
                         "the launch config disables them (E8); above "

@@ -77,6 +77,12 @@ class SpecBackend(NamedTuple):
     # telemetry into the sticky certificate carry/ring column - it
     # feeds no arbitration, so narrowed counts stay comparable
     cert_check: object = None
+    # optional device coverage plane (obs.coverage.CoveragePlane,
+    # ISSUE 11): a stable per-site table + a count hook the expand
+    # stage folds into the cumulative [n_sites] uint32 coverage leaf.
+    # Pure telemetry - feeds no control flow, so coverage-on results
+    # are bit-for-bit coverage-off results
+    coverage: object = None
 
 
 class ExpandOut(NamedTuple):
@@ -100,6 +106,10 @@ class ExpandOut(NamedTuple):
     # certified bound (None on backends without a cert_check, so
     # pre-certificate carries/stages keep their exact pytree layout)
     cert: jnp.ndarray = None
+    # [n_sites] uint32 per-site coverage visit increments of this block
+    # (None on backends without a coverage plane, so coverage-off
+    # carries/stages keep their exact pytree layout)
+    cov: jnp.ndarray = None
 
 
 def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
@@ -161,6 +171,16 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
         if backend.cert_check is not None:
             cert = backend.cert_check(flat, fvalid)
 
+        # device coverage plane (ISSUE 11): this block's per-site
+        # visit increments, folded into the cumulative carry leaf by
+        # the commit stage.  `valid` already carries the pop mask, so
+        # the hook sees exactly the lane validity the counters see
+        cov = None
+        if backend.coverage is not None:
+            cov = backend.coverage.count(batch, mask, valid).astype(
+                jnp.uint32
+            )
+
         # per-action generated counters, scatter-free: the backend's
         # factorized hook (KubeAPI dispatch structure, PERF.md item 5)
         # when it has one, a [L, n_labels] fold for static lane
@@ -208,13 +228,14 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
         return ExpandOut(
             packed=packed, lo=lo, hi=hi, valid=fvalid, action=faction,
             gen=gen, viol=viol, viol_state=viol_state,
-            viol_action=viol_action, cert=cert,
+            viol_action=viol_action, cert=cert, cov=cov,
         )
 
     return expand
 
 
-def kubeapi_backend(cfg: ModelConfig) -> SpecBackend:
+def kubeapi_backend(cfg: ModelConfig,
+                    coverage: bool = False) -> SpecBackend:
     cdc = get_codec(cfg)
     step = make_kernel(cfg)
     CL, _ = lane_layout(cfg)
@@ -240,6 +261,13 @@ def kubeapi_backend(cfg: ModelConfig) -> SpecBackend:
             valid[:, nc * CL :].sum().astype(jnp.uint32)
         )
 
+    plane = None
+    if coverage:
+        # the device site table pinned span-for-span against the host
+        # coverage walker (spec.coverage) on the tracked subset
+        from ..spec.coverage_device import kubeapi_coverage_plane
+
+        plane = kubeapi_coverage_plane(cfg)
     return SpecBackend(
         cdc=cdc,
         step=step,
@@ -250,6 +278,7 @@ def kubeapi_backend(cfg: ModelConfig) -> SpecBackend:
         labels=LABELS,
         viol_names={},
         gen_counts=gen_counts,
+        coverage=plane,
     )
 
 

@@ -139,6 +139,14 @@ class EngineCarry(NamedTuple):
     # error verdict by the check drivers - never silent.
     cert_viol: jnp.ndarray = None  # bool
     st_cert: jnp.ndarray = None  # staged block's cert bit (pipelined)
+    # --- device coverage plane (None without a backend coverage plane)
+    # Cumulative [n_sites] uint32 per-site visit counters (obs.coverage,
+    # ISSUE 11): incremented by every commit from the expand stage's
+    # block increments, read back at segment fences, migrated verbatim
+    # on regrow, checkpointed/resumed, psum-merged across shards.  Pure
+    # telemetry, exactly like the obs ring above.
+    cov_counts: jnp.ndarray = None  # [n_sites] uint32
+    st_cov: jnp.ndarray = None  # staged block's increments (pipelined)
 
 
 class CheckResult(NamedTuple):
@@ -165,6 +173,9 @@ class CheckResult(NamedTuple):
     # Reported on the 2193 stats line so users can size fp_capacity (and
     # see how close a run came to the fp_highwater regrow trigger)
     fp_occupancy: float = None
+    # device per-site coverage totals ({site key: visits}, obs.coverage);
+    # None when the engine carried no coverage plane
+    site_coverage: dict = None
     # runtime-certificate verdict of a narrowed (certified-bound) run:
     # None = no certificate check carried; False = every generated
     # state satisfied the certified bounds; True = a claimed bound was
@@ -198,18 +209,22 @@ def make_engine(
     pipeline: bool = False,
     donate: bool = True,
     obs_slots: int = 0,
+    coverage: bool = False,
 ):
     """Build (init_fn, run_fn, step_fn) for one KubeAPI configuration.
 
     The hand-tuned KubeAPI path of make_backend_engine: the factorized
     per-action counters and the rest of the v4 loop now come through the
-    SpecBackend seam, so this is a specialization, not a privilege."""
+    SpecBackend seam, so this is a specialization, not a privilege.
+    `coverage` compiles the device per-site coverage plane in
+    (spec.coverage_device; the carry layout changes, so checkpoints
+    record the flag)."""
     from .backend import kubeapi_backend
 
     return make_backend_engine(
-        kubeapi_backend(cfg), chunk, queue_capacity, fp_capacity,
-        fp_index, seed, fp_highwater=fp_highwater, pipeline=pipeline,
-        donate=donate, obs_slots=obs_slots,
+        kubeapi_backend(cfg, coverage=coverage), chunk, queue_capacity,
+        fp_capacity, fp_index, seed, fp_highwater=fp_highwater,
+        pipeline=pipeline, donate=donate, obs_slots=obs_slots,
     )
 
 
@@ -445,6 +460,10 @@ def make_stage_pair(
             # later carry (and ring row) carries the flag
             cert_now = c.cert_viol | ex.cert
             extra["cert_viol"] = cert_now
+        if ex.cov is not None and c.cov_counts is not None:
+            # device coverage plane: fold this block's per-site visit
+            # increments into the cumulative counters (telemetry only)
+            extra["cov_counts"] = c.cov_counts + ex.cov
         obs = {}
         if obs_slots:
             # one telemetry row per completed level (post-commit
@@ -462,6 +481,8 @@ def make_stage_pair(
             ]
             if spill:
                 wrap_pairs.append((extra["spill_hits"], c.spill_hits))
+            if "cov_counts" in extra:
+                wrap_pairs.append((extra["cov_counts"], c.cov_counts))
             wrapped = wrapped_any(wrap_pairs)
             row = pack_row(
                 c.level, generated, distinct, level_n, obs_bodies,
@@ -569,6 +590,8 @@ def make_backend_engine(
 
     assert 0.0 < fp_highwater <= 1.0, "fp_highwater must be in (0, 1]"
     has_cert = backend.cert_check is not None
+    cov_plane = backend.coverage
+    n_sites = cov_plane.n_sites if cov_plane is not None else 0
     cdc = backend.cdc
     F = cdc.n_fields
     W = (cdc.nbits + 31) // 32
@@ -640,8 +663,17 @@ def make_backend_engine(
             )
             if has_cert:
                 staged["st_cert"] = jnp.bool_(False)
+            if cov_plane is not None:
+                staged["st_cov"] = jnp.zeros(n_sites, jnp.uint32)
         if has_cert:
             staged["cert_viol"] = jnp.bool_(False)
+        if cov_plane is not None:
+            # coverage counters seeded with the Init-site visits (the
+            # host-side charge for the seed states; zero when the plane
+            # tracks no Init sites)
+            staged["cov_counts"] = jnp.asarray(
+                cov_plane.seed(np.asarray(inits))
+            )
         obs = {}
         if obs_slots:
             ring, head = ring_new(obs_slots, n_labels)
@@ -697,6 +729,8 @@ def make_backend_engine(
 
         def with_staged(c: EngineCarry, ex, n) -> EngineCarry:
             extra = {"st_cert": ex.cert} if has_cert else {}
+            if cov_plane is not None:
+                extra["st_cov"] = ex.cov
             return c._replace(
                 st_packed=ex.packed, st_lo=ex.lo, st_hi=ex.hi,
                 st_valid=ex.valid, st_action=ex.action, st_gen=ex.gen,
@@ -711,6 +745,7 @@ def make_backend_engine(
                 viol=c.st_viol, viol_state=c.st_viol_state,
                 viol_action=c.st_viol_action,
                 cert=c.st_cert if has_cert else None,
+                cov=c.st_cov if cov_plane is not None else None,
             )
 
         # The two-deep pipeline body, bubble-free: the staged block k-1
@@ -799,6 +834,7 @@ def check(
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
     pipeline: bool = False,
     obs_slots: int = 0,
+    coverage: bool = False,
 ) -> CheckResult:
     """Run an exhaustive check; the single-device engine entry point.
 
@@ -806,8 +842,11 @@ def check(
     wall_s measures execution only - the honest time-to-exhaustive figure
     (compilation is a one-time cost, amortized in TLC by the JVM the same
     way)."""
-    init_fn, run_fn, _ = make_engine(
-        cfg, chunk, queue_capacity, fp_capacity, fp_index, seed,
+    from .backend import kubeapi_backend
+
+    backend = kubeapi_backend(cfg, coverage=coverage)
+    init_fn, run_fn, _ = make_backend_engine(
+        backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater=fp_highwater, pipeline=pipeline, obs_slots=obs_slots,
     )
     carry = init_fn()
@@ -818,9 +857,10 @@ def check(
     from .fpset import fpset_actual_collision
 
     afc = float(fpset_actual_collision(carry.fps))
-    return result_from_carry(carry, wall, fp_capacity=fp_capacity)._replace(
-        actual_fp_collision=afc
-    )
+    sites = backend.coverage.sites if backend.coverage else None
+    return result_from_carry(
+        carry, wall, fp_capacity=fp_capacity, sites=sites
+    )._replace(actual_fp_collision=afc)
 
 
 def obs_rows(carry, labels: tuple = None, since: int = 0,
@@ -1041,9 +1081,23 @@ def outdegree_from_hist(hist: np.ndarray):
     )
 
 
+def cov_totals(carry) -> "np.ndarray | None":
+    """Cumulative per-site coverage counters of a carry ([n_sites]
+    int64 host array; shard carries sum their device partials), or
+    None when no coverage plane rides the carry."""
+    counts = getattr(carry, "cov_counts", None)
+    if counts is None:
+        return None
+    counts = np.asarray(counts).astype(np.int64)
+    if counts.ndim == 2:  # sharded: [D, n_sites] partials
+        counts = counts.sum(axis=0)
+    return counts
+
+
 def result_from_carry(
     carry: EngineCarry, wall_s: float, iterations: int = -1,
     fp_capacity: int = 0, labels: tuple = LABELS, viol_names: dict = None,
+    sites: tuple = None,
 ) -> CheckResult:
     """Pull a finished (or interrupted) carry to host as a CheckResult."""
     act_gen = np.asarray(carry.act_gen)[: len(labels)]
@@ -1062,6 +1116,12 @@ def result_from_carry(
     staged_n = int(carry.st_n) if carry.st_n is not None else 0
     cert = getattr(carry, "cert_viol", None)
     cert_violated = bool(cert) if cert is not None else None
+    site_coverage = None
+    totals = cov_totals(carry)
+    if totals is not None and sites is not None:
+        from ..obs.coverage import site_totals_dict
+
+        site_coverage = site_totals_dict(sites, totals)
     return CheckResult(
         generated=int(carry.generated),
         distinct=int(carry.distinct),
@@ -1085,4 +1145,5 @@ def result_from_carry(
         outdegree=outdegree,
         fp_occupancy=occupancy,
         cert_violated=cert_violated,
+        site_coverage=site_coverage,
     )

@@ -144,6 +144,11 @@ class ShardCarry(NamedTuple):
     # ride the carry.  Fixes the PR 5 documented per-level act_dist lag.
     obs_pl_level: jnp.ndarray = None  # [D] int32 staged flip's level
     obs_pl_flag: jnp.ndarray = None  # [D] bool a flip row is staged
+    # --- device coverage plane (None without a backend plane) ----------
+    # Per-device partial per-site visit counters (obs.coverage); summed
+    # across the mesh axis at readback (engine.bfs.cov_totals), exactly
+    # like the partial generated/distinct counters above.
+    cov_counts: jnp.ndarray = None  # [D, n_sites] uint32
 
 
 def route_bucket_width(chunk: int, n_lanes: int, D: int,
@@ -265,8 +270,15 @@ def make_sharded_engine(
         gen = np.zeros(D, np.uint32)
         gen[0] = n0  # count initial generation once (device 0's partial)
         pv = {}
+        if backend.coverage is not None:
+            # Init-site visits charged to device 0's partial (like the
+            # initial-generation credit above)
+            seed_row = backend.coverage.seed(inits)
+            cov0 = np.zeros((D, len(seed_row)), np.uint32)
+            cov0[0] = seed_row
+            pv["cov_counts"] = jnp.asarray(cov0)
         if pipeline:
-            pv = dict(
+            pv.update(
                 pv_send=jnp.zeros((D, D, B), jnp.uint8),
                 pv_sown=jnp.zeros((D, ncand), jnp.int32),
                 pv_pos=jnp.zeros((D, ncand), jnp.int32),
@@ -475,6 +487,15 @@ def make_sharded_engine(
         distinct = my_distinct + n_new.astype(jnp.uint32)
         act_gen = c.act_gen[0].at[jnp.where(fvalid, faction, n_labels)].add(1)
 
+        cov_acc = {}
+        if backend.coverage is not None:
+            # device coverage plane: per-device partial visit counters,
+            # summed across the mesh at readback (pure telemetry)
+            cov = backend.coverage.count(batch, mask, valid).astype(
+                jnp.uint32
+            )
+            cov_acc = dict(cov_counts=(c.cov_counts[0] + cov)[None])
+
         # ---- violations (local detect, global max) ----
         new_viol = jnp.int32(OK)
         new_vstate = viol_state
@@ -614,6 +635,7 @@ def make_sharded_engine(
             cont=cont[None],
             **pv2,
             **obs2,
+            **cov_acc,
         )
 
     def device_loop(c: ShardCarry) -> ShardCarry:
@@ -641,6 +663,8 @@ def make_sharded_engine(
             pv_specs.update(
                 obs_pl_level=P(axis), obs_pl_flag=P(axis)
             )
+    if backend.coverage is not None:
+        pv_specs["cov_counts"] = P(axis)
     specs = ShardCarry(
         table=P(axis),
         queue=P(axis),
@@ -675,7 +699,7 @@ def make_sharded_engine(
 def result_from_shard_carry(
     out: ShardCarry, wall: float, iterations: int = -1,
     labels: tuple = LABELS, viol_names: dict = None,
-    fp_capacity_total: int = 0,
+    fp_capacity_total: int = 0, sites: tuple = None,
 ) -> CheckResult:
     """Globally-reduced statistics from a (finished or paused) carry.
 
@@ -692,6 +716,12 @@ def result_from_shard_carry(
     vname = (viol_names or {}).get(viol) or VIOLATION_NAMES.get(
         viol, f"violation {viol}"
     )
+    site_coverage = None
+    if sites is not None and getattr(out, "cov_counts", None) is not None:
+        from ..obs.coverage import site_totals_dict
+        from .bfs import cov_totals
+
+        site_coverage = site_totals_dict(sites, cov_totals(out))
     return CheckResult(
         generated=int(np.asarray(out.generated).sum()),
         distinct=int(np.asarray(out.distinct).sum()),
@@ -714,6 +744,7 @@ def result_from_shard_carry(
             int(np.asarray(out.distinct).sum()) / fp_capacity_total
             if fp_capacity_total else None
         ),
+        site_coverage=site_coverage,
     )
 
 
@@ -887,6 +918,7 @@ def check_sharded(
     return result_from_shard_carry(
         out, wall, labels=backend.labels, viol_names=backend.viol_names,
         fp_capacity_total=fp_capacity * mesh.devices.size,
+        sites=backend.coverage.sites if backend.coverage else None,
     )
 
 

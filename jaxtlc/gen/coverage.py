@@ -112,13 +112,27 @@ def coverage_walk(spec: GenSpec, module_text: str = "",
 def render_coverage(module: str, init_count: int,
                     cov: Dict[str, ActionCoverage],
                     stamp: str) -> List[str]:
-    """TLC-shaped coverage block (message framing added by the caller)."""
+    """TLC-shaped coverage block (message framing added by the caller).
+
+    Unified on the shared site-table vocabulary (obs.coverage,
+    ISSUE 11): the per-action lines render from the action-site PREFIX
+    of the table - the same ordering contract the device coverage
+    plane's site tables open with - so the per-action renderer and the
+    per-site renderer are two views of one accounting, not two
+    accountings."""
+    from ..obs.coverage import action_site_table
+
+    locs = {
+        name: (f"line {c.line} of module {module}"
+               if c.line else f"of module {module}")
+        for name, c in cov.items()
+    }
+    sites = action_site_table(module, list(cov), locs=locs)
     out = [f"The coverage statistics at {stamp}"]
     out.append(f"<Init of module {module}>: {init_count}:{init_count}")
-    for name, c in cov.items():
-        where = (f"line {c.line} of module {module}"
-                 if c.line else f"of module {module}")
-        out.append(f"<{name} {where}>: {c.distinct}:{c.generated}")
+    for s in sites:
+        c = cov[s.action]
+        out.append(f"<{s.action} {s.loc}>: {c.distinct}:{c.generated}")
         out.append(f"  |guard: {c.guard_evals} evaluations, "
                    f"{c.guard_true} enabled")
         for var, n in c.update_evals.items():

@@ -84,6 +84,13 @@ EVENTS = {
     "final": {"verdict": _STR, "generated": _NUM, "distinct": _NUM,
               "depth": _NUM, "queue": _NUM, "wall_s": _NUM,
               "interrupted": _BOOL},
+    # -- device coverage plane (obs.coverage, ISSUE 11) --------------------
+    # one per segment fence with coverage movement: nonzero per-site
+    # visit DELTAS since the previous event (cumulative totals are the
+    # fold of all deltas - obs.coverage.coverage_from_events), plus the
+    # visited-site header.  An event with saturated=true (extra field)
+    # is the "no new site for N levels" signal.
+    "coverage": {"visited": _NUM, "sites": _NUM, "delta": (dict,)},
     # -- phase attribution (obs.phases) ------------------------------------
     # one measured wall per (scope, index, phase): scope "segment" rows
     # come free at the fences the supervisor already pays (phase

@@ -49,9 +49,58 @@ JOURNAL_SUFFIX = ".journal.jsonl"
 POLL_S = 0.2
 
 
+# /runs registry cache: journal scans keyed on (path, mtime, size) so
+# a poll over a directory of mostly-idle runs rescans only the files
+# that actually changed (ISSUE 11 satellite; the old code re-read every
+# journal per request).  Rows are immutable snapshots; entries for
+# vanished files are dropped on the next scan.
+_RUNS_CACHE: dict = {}
+_RUNS_CACHE_LOCK = threading.Lock()
+
+
+def _run_row(p: str) -> Optional[dict]:
+    try:
+        st = os.stat(p)
+    except OSError:
+        return None
+    key = (st.st_mtime_ns, st.st_size)
+    with _RUNS_CACHE_LOCK:
+        hit = _RUNS_CACHE.get(p)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+    try:
+        events = jr.read(p, validate=False)
+    except OSError:
+        return None
+    manifest = next(
+        (e for e in events if e["event"] == "run_start"), None
+    )
+    fin = next(
+        (e for e in reversed(events) if e["event"] == "final"), None
+    )
+    row = {
+        "run": os.path.basename(p)[: -len(JOURNAL_SUFFIX)]
+        if p.endswith(JOURNAL_SUFFIX) else os.path.basename(p),
+        "path": p,
+        "events": len(events),
+        "workload": manifest["workload"] if manifest else None,
+        "engine": manifest["engine"] if manifest else None,
+        "verdict": fin["verdict"] if fin else "running",
+        "last_t": events[-1]["t"] if events else None,
+        "resumes": sum(
+            1 for e in events if e["event"] == "run_resume"
+        ),
+    }
+    with _RUNS_CACHE_LOCK:
+        _RUNS_CACHE[p] = (key, row)
+    return row
+
+
 def _runs(root: str) -> List[dict]:
     """The run registry: one row per journal under `root` (or the row
-    of `root` itself when it IS a journal file), newest first."""
+    of `root` itself when it IS a journal file), newest first.  Scans
+    are cached by (path, mtime, size) - unchanged journals cost one
+    stat per request, not a full re-read."""
     paths = []
     if os.path.isdir(root):
         for name in sorted(os.listdir(root)):
@@ -59,31 +108,12 @@ def _runs(root: str) -> List[dict]:
                 paths.append(os.path.join(root, name))
     elif os.path.exists(root):
         paths = [root]
-    rows = []
-    for p in paths:
-        try:
-            events = jr.read(p, validate=False)
-        except OSError:
-            continue
-        manifest = next(
-            (e for e in events if e["event"] == "run_start"), None
-        )
-        fin = next(
-            (e for e in reversed(events) if e["event"] == "final"), None
-        )
-        rows.append({
-            "run": os.path.basename(p)[: -len(JOURNAL_SUFFIX)]
-            if p.endswith(JOURNAL_SUFFIX) else os.path.basename(p),
-            "path": p,
-            "events": len(events),
-            "workload": manifest["workload"] if manifest else None,
-            "engine": manifest["engine"] if manifest else None,
-            "verdict": fin["verdict"] if fin else "running",
-            "last_t": events[-1]["t"] if events else None,
-            "resumes": sum(
-                1 for e in events if e["event"] == "run_resume"
-            ),
-        })
+    rows = [r for r in (_run_row(p) for p in paths) if r is not None]
+    with _RUNS_CACHE_LOCK:
+        for stale in set(_RUNS_CACHE) - set(paths):
+            if os.path.dirname(stale) == (root if os.path.isdir(root)
+                                          else os.path.dirname(root)):
+                _RUNS_CACHE.pop(stale, None)
     rows.sort(key=lambda r: r["last_t"] or 0, reverse=True)
     return rows
 
@@ -111,8 +141,64 @@ def prometheus_text(metrics: dict) -> str:
                     f"{secs}"
                 )
             continue
+        if key == "coverage_sites":
+            # the device coverage plane's per-site counters (ISSUE 11)
+            lines.append("# HELP jaxtlc_coverage_site_total cumulative "
+                         "visits per coverage site")
+            lines.append("# TYPE jaxtlc_coverage_site_total counter")
+            for site, n in sorted(val.items()):
+                lines.append(
+                    f'jaxtlc_coverage_site_total{{site="{site}"}} {n}'
+                )
+            continue
         lines.append(f"jaxtlc_{key} {val}")
     return "\n".join(lines) + "\n"
+
+
+class _JournalTail:
+    """Seek-position tail over an append-only journal (ISSUE 11
+    satellite: the /events SSE poll used to re-read the WHOLE file per
+    tick - O(file) per poll; this reads only the bytes appended since
+    the last complete line).  The torn-trailing-line contract is
+    preserved: a line without its newline yet (the writer's crash
+    window) is buffered and held back until the writer completes it, so
+    a subscriber never sees a partial event and never sees one twice.
+    A file that shrank (recreated journal) resets the tail."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.pos = 0  # file offset of the next unread byte
+        self._buf = b""  # held-back torn trailing line
+
+    def poll(self) -> List[dict]:
+        """Complete events appended since the last poll."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self.pos:
+            self.pos = 0
+            self._buf = b""
+        if size == self.pos:
+            return []
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self.pos)
+                chunk = f.read()
+        except OSError:
+            return []
+        self.pos += len(chunk)
+        lines = (self._buf + chunk).split(b"\n")
+        self._buf = lines[-1]  # b"" after a complete trailing newline
+        out = []
+        for ln in lines[:-1]:
+            if not ln.strip():
+                continue
+            try:
+                out.append(json.loads(ln.decode("utf-8")))
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue  # defensive: skip an unparseable mid-file line
+        return out
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -176,6 +262,24 @@ class _Handler(BaseHTTPRequestHandler):
                     json.dumps(e, sort_keys=True) + "\n" for e in events
                 ).encode()
                 self._send(200, body, "application/x-ndjson")
+            elif route == "/coverage":
+                # live device coverage: cumulative per-site totals,
+                # derived from the journal's `coverage` delta events
+                # (the same fold the Prometheus counters render)
+                path = self._journal_path(qs)
+                if path is None:
+                    self._send(404, b"no journal\n", "text/plain")
+                    return
+                from .coverage import coverage_from_events
+
+                events = jr.read(path, validate=False)
+                cov = coverage_from_events(events)
+                if cov is None:
+                    self._send(404, b"run has no coverage plane\n",
+                               "text/plain")
+                    return
+                self._send(200, json.dumps(cov).encode(),
+                           "application/json")
             elif route == "/events":
                 self._events(qs)
             elif route == "/":
@@ -183,6 +287,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "jaxtlc run monitor\n"
                     "  /runs     run registry (JSON)\n"
                     "  /metrics  Prometheus text   [?run=NAME]\n"
+                    "  /coverage live per-site coverage [?run=NAME]\n"
                     "  /events   SSE journal tail  [?run=NAME]"
                     "[&once=1][&since=N]\n"
                     "  /journal  raw JSONL         [?run=NAME]\n"
@@ -195,34 +300,38 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _events(self, qs: dict) -> None:
         """SSE tail: emit every complete journal line, then poll for
-        appends.  jr.read holds back a torn trailing line until the
-        writer completes it, so a subscriber never sees a partial
-        event (and never sees it twice).  The stream survives the
-        writer's interrupt+`-recover` because resume APPENDS to the
-        same file - one continuous stream per logical run."""
+        appends with a SEEK-POSITION tail (_JournalTail) - each tick
+        reads only the appended bytes, not the whole file, and a torn
+        trailing line is held back until the writer completes it, so a
+        subscriber never sees a partial event (and never sees it
+        twice).  The stream survives the writer's interrupt+`-recover`
+        because resume APPENDS to the same file - one continuous
+        stream per logical run."""
         path = self._journal_path(qs)
         if path is None:
             self._send(404, b"no journal\n", "text/plain")
             return
         once = qs.get("once", ["0"])[0] not in ("0", "")
-        emitted = int(qs.get("since", ["0"])[0])
+        skip = int(qs.get("since", ["0"])[0])
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         # SSE is an unbounded stream: no Content-Length, close delimits
         self.send_header("Connection", "close")
         self.end_headers()
+        tail = _JournalTail(path)
+        emitted = 0
         while not self.server._jaxtlc_shutdown.is_set():
-            try:
-                events = jr.read(path, validate=False)
-            except OSError:
-                events = []
-            for ev in events[emitted:]:
+            wrote = False
+            for ev in tail.poll():
+                emitted += 1
+                if emitted <= skip:
+                    continue
                 data = json.dumps(ev, sort_keys=True)
                 self.wfile.write(f"data: {data}\n\n".encode())
-            if len(events) > emitted:
+                wrote = True
+            if wrote:
                 self.wfile.flush()
-            emitted = max(emitted, len(events))
             if once:
                 return
             time.sleep(POLL_S)

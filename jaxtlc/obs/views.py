@@ -133,6 +133,19 @@ def metrics_from_events(events) -> dict:
         out["phase_wall_seconds"] = {
             k: round(v, 6) for k, v in sorted(phases.items())
         }
+    from .coverage import coverage_from_events
+
+    cov = coverage_from_events(events)
+    if cov is not None:
+        # per-site cumulative counters (Prometheus coverage_site_total)
+        # + the visited/total header gauges
+        out["coverage_sites"] = cov["sites"]
+        out["coverage_visited"] = cov["visited"]
+        out["coverage_n_sites"] = cov["n_sites"]
+        if cov.get("saturated_at_level") is not None:
+            out["coverage_saturated_at_level"] = (
+                cov["saturated_at_level"]
+            )
     if fin is not None:
         out["wall_seconds"] = fin["wall_s"]
     return out

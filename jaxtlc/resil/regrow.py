@@ -180,6 +180,13 @@ def migrate_engine_carry(
         staged["st_cert"] = jnp.asarray(
             np.asarray(carry.st_cert), bool
         )
+    # device coverage counters: telemetry, shape depends on neither
+    # capacity - travel verbatim so per-site history survives regrow
+    for f in ("cov_counts", "st_cov"):
+        if getattr(carry, f, None) is not None:
+            staged[f] = jnp.asarray(
+                np.asarray(getattr(carry, f)), jnp.uint32
+            )
 
     return EngineCarry(
         fps=fps2,
@@ -288,6 +295,11 @@ def migrate_shard_carry(
             f: jnp.asarray(np.asarray(getattr(carry, f)))
             for f in ("obs_pl_level", "obs_pl_flag")
         })
+    if getattr(carry, "cov_counts", None) is not None:
+        # device coverage partials: telemetry, geometry-independent
+        pv["cov_counts"] = jnp.asarray(
+            np.asarray(carry.cov_counts), jnp.uint32
+        )
     return ShardCarry(
         table=jnp.asarray(table2),
         queue=jnp.asarray(queue2),

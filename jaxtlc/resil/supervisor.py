@@ -195,6 +195,11 @@ class SupervisorOptions:
     spill_capacity: int = 1 << 15
     # rung-3 floor: chunk never shrinks below this
     min_chunk: int = MIN_CHUNK
+    # coverage-saturation signal: once the run has gone this many BFS
+    # levels without visiting a NEW coverage site, one `coverage`
+    # journal event with saturated=true is emitted (the live "the spec
+    # stopped exploring new behavior" cue; only with a coverage plane)
+    coverage_sat_levels: int = 8
     # on_event(kind, info_dict): checkpoint / ckpt_write_failed / recovery
     # / regrow / retry / interrupted / progress / spill / degrade /
     # exhausted - the tlc_log banner seam
@@ -262,24 +267,35 @@ class SingleDeviceAdapter:
     kind = "single"
     GEOM_KEYS = ("queue_capacity", "fp_capacity")
     FIXED_KEYS = ("format", "config", "chunk", "fp_index", "seed",
-                  "fp_highwater", "pipeline", "obs_slots")
+                  "fp_highwater", "pipeline", "obs_slots", "coverage")
 
     def __init__(self, cfg, chunk: int = 1024,
                  fp_index: int = DEFAULT_FP_INDEX, seed: int = DEFAULT_SEED,
                  fp_highwater: float = DEFAULT_FP_HIGHWATER,
                  backend=None, meta_config: dict = None,
                  check_deadlock: bool = True, pipeline: bool = False,
-                 obs_slots: int = 0):
+                 obs_slots: int = 0, coverage: bool = False):
         self.cfg = cfg
         self.chunk = chunk
         self.fp_index = fp_index
         self.seed = seed
         self.fp_highwater = fp_highwater
+        if backend is None and coverage:
+            # the KubeAPI path with the device coverage plane: build
+            # the covered backend once so sites/meta/engine agree
+            from ..engine.backend import kubeapi_backend
+
+            backend = kubeapi_backend(cfg, coverage=True)
+            check_deadlock = True  # the kubeapi backend's own default
         self.backend = backend
         self.meta_config = meta_config
         self.check_deadlock = check_deadlock
         self.pipeline = pipeline
         self.obs_slots = obs_slots
+        # the flag that shapes the carry layout (checkpoint meta key):
+        # True iff the engine actually carries the coverage leaves
+        self.coverage = (backend is not None
+                         and backend.coverage is not None)
 
     def build(self, params: dict, ckpt_every: int):
         # donate=False: the supervisor feeds the SAME last-good carry
@@ -322,7 +338,7 @@ class SingleDeviceAdapter:
             self.cfg, meta_config=self.meta_config, chunk=self.chunk,
             fp_index=self.fp_index, seed=self.seed,
             fp_highwater=self.fp_highwater, pipeline=self.pipeline,
-            obs_slots=self.obs_slots,
+            obs_slots=self.obs_slots, coverage=self.coverage,
             **params,
         )
 
@@ -331,6 +347,18 @@ class SingleDeviceAdapter:
 
     def done(self, carry) -> bool:
         return carry_done(carry)
+
+    def cov_sites(self):
+        """The coverage plane's site table (None when coverage is off);
+        the supervisor keys its `coverage` journal deltas on it."""
+        if self.backend is not None and self.backend.coverage is not None:
+            return self.backend.coverage.sites
+        return None
+
+    def cov_totals(self, carry):
+        from ..engine.bfs import cov_totals
+
+        return cov_totals(carry)
 
     def obs_rows(self, carry, since: int, params: dict):
         """New observability-ring rows since cursor `since` (journal
@@ -437,7 +465,8 @@ class SingleDeviceAdapter:
         kw = {}
         if self.backend is not None:
             kw = dict(labels=self.backend.labels,
-                      viol_names=self.backend.viol_names)
+                      viol_names=self.backend.viol_names,
+                      sites=self.cov_sites())
         return result_from_carry(
             carry, wall, iterations=segments,
             fp_capacity=params["fp_capacity"], **kw,
@@ -451,22 +480,25 @@ class ShardedAdapter:
     kind = "sharded"
     GEOM_KEYS = ("queue_capacity", "fp_capacity", "route_factor")
     FIXED_KEYS = ("format", "config", "devices", "fp_highwater",
-                  "pipeline", "obs_slots")
+                  "pipeline", "obs_slots", "coverage")
 
     def __init__(self, cfg, mesh, chunk: int = 512, backend=None,
                  meta_config: dict = None,
                  fp_highwater: float = DEFAULT_FP_HIGHWATER,
-                 pipeline: bool = False, obs_slots: int = 0):
+                 pipeline: bool = False, obs_slots: int = 0,
+                 coverage: bool = False):
         from ..engine.sharded import kubeapi_backend
 
         self.cfg = cfg
         self.mesh = mesh
         self.chunk = chunk
-        self.backend = backend if backend is not None else kubeapi_backend(cfg)
+        self.backend = (backend if backend is not None
+                        else kubeapi_backend(cfg, coverage=coverage))
         self.meta_config = meta_config
         self.fp_highwater = fp_highwater
         self.pipeline = pipeline
         self.obs_slots = obs_slots
+        self.coverage = self.backend.coverage is not None
 
     def build(self, params: dict, ckpt_every: int):
         from ..engine.sharded import make_sharded_engine
@@ -488,9 +520,19 @@ class ShardedAdapter:
             self.cfg, meta_config=self.meta_config, chunk=self.chunk,
             devices=int(self.mesh.devices.size),
             fp_highwater=self.fp_highwater, pipeline=self.pipeline,
-            obs_slots=self.obs_slots,
+            obs_slots=self.obs_slots, coverage=self.coverage,
             **params,
         )
+
+    def cov_sites(self):
+        if self.backend.coverage is not None:
+            return self.backend.coverage.sites
+        return None
+
+    def cov_totals(self, carry):
+        from ..engine.bfs import cov_totals
+
+        return cov_totals(carry)
 
     def viol(self, carry) -> int:
         return int(np.asarray(carry.viol).max())
@@ -539,6 +581,7 @@ class ShardedAdapter:
             fp_capacity_total=(
                 params["fp_capacity"] * int(self.mesh.devices.size)
             ),
+            sites=self.cov_sites(),
         )
 
 
@@ -549,9 +592,10 @@ def _params_from_meta(adapter, meta: dict, params: dict) -> dict:
     travel with the snapshot, so the resume command needs none of them)."""
     want = adapter.meta(params)
     for key in adapter.FIXED_KEYS:
-        # pre-pipeline/pre-obs snapshots carry no key: they were cut
-        # from engines without those features, so missing means off
-        have = meta.get(key, False if key == "pipeline"
+        # pre-pipeline/pre-obs/pre-coverage snapshots carry no key:
+        # they were cut from engines without those features, so
+        # missing means off
+        have = meta.get(key, False if key in ("pipeline", "coverage")
                         else 0 if key == "obs_slots" else None)
         if have != want.get(key):
             raise ValueError(
@@ -807,6 +851,20 @@ def supervise(adapter, params: dict,
     obs_seen = 0
     if obs_read is not None:
         _, obs_seen = obs_read(carry, 0, params)
+    # coverage cursor: per-site totals already journaled.  A resumed
+    # carry's restored totals are in the original journal, so they seed
+    # the cursor; a fresh run's first event carries the Init visits.
+    cov_sites = None
+    if callable(getattr(adapter, "cov_sites", None)):
+        cov_sites = adapter.cov_sites()
+    cov_seen = None
+    cov_visited = 0
+    cov_level = 0
+    cov_last_new_level = 0
+    cov_saturated = False
+    if cov_sites is not None and opts.resume:
+        cov_seen = adapter.cov_totals(carry)
+        cov_visited = int((cov_seen > 0).sum())
     # deferred periodic checkpoint: written while the NEXT segment is in
     # flight, so snapshot serialization/fsync overlaps device execution
     # instead of stalling the step loop (the carry is safe to read
@@ -1062,6 +1120,31 @@ def supervise(adapter, params: dict,
                 rows, obs_seen = obs_read(carry, obs_seen, params)
                 for row in rows:
                     _emit(opts, "level", **row)
+                if rows:
+                    cov_level = max(cov_level, rows[-1]["level"])
+            if cov_sites is not None:
+                # device coverage readback at the fence already paid:
+                # per-site DELTAS journal as one `coverage` event, and
+                # a run that stops visiting NEW sites for N levels
+                # journals the saturation signal once
+                from ..obs.coverage import coverage_delta_event
+
+                totals = adapter.cov_totals(carry)
+                payload = coverage_delta_event(cov_sites, totals,
+                                               cov_seen)
+                if payload is not None:
+                    _emit(opts, "coverage", **payload)
+                    cov_seen = totals
+                    if payload["visited"] > cov_visited:
+                        cov_visited = payload["visited"]
+                        cov_last_new_level = cov_level
+                if (not cov_saturated and cov_visited
+                        and cov_level - cov_last_new_level
+                        >= opts.coverage_sat_levels):
+                    cov_saturated = True
+                    _emit(opts, "coverage", visited=cov_visited,
+                          sites=len(cov_sites), delta={},
+                          saturated=True, level=cov_level)
             # phase attribution (obs.phases): the free fence-scope rows
             # (device wall + the host readback wall just measured) plus
             # the measured per-level expand/commit walls in -phase-
@@ -1152,17 +1235,20 @@ def check_supervised(
     check_deadlock: bool = True,
     pipeline: bool = False,
     obs_slots: int = 0,
+    coverage: bool = False,
     opts: SupervisorOptions = None,
 ) -> SupervisedResult:
     """Supervised single-device exhaustive check (the check_with_
     checkpoints signature, plus self-healing).  `backend`/`meta_config`
     run any SpecBackend (struct-compiled specs included) through the
-    same supervision loop; cfg is then ignored."""
+    same supervision loop; cfg is then ignored.  `coverage` (KubeAPI
+    path) compiles the device coverage plane into the engine; a
+    backend that already carries a plane turns it on regardless."""
     adapter = SingleDeviceAdapter(
         cfg, chunk=chunk, fp_index=fp_index, seed=seed,
         fp_highwater=fp_highwater, backend=backend,
         meta_config=meta_config, check_deadlock=check_deadlock,
-        pipeline=pipeline, obs_slots=obs_slots,
+        pipeline=pipeline, obs_slots=obs_slots, coverage=coverage,
     )
     return supervise(
         adapter,
@@ -1183,13 +1269,14 @@ def check_sharded_supervised(
     fp_highwater: float = DEFAULT_FP_HIGHWATER,
     pipeline: bool = False,
     obs_slots: int = 0,
+    coverage: bool = False,
     opts: SupervisorOptions = None,
 ) -> SupervisedResult:
     """Supervised mesh-sharded exhaustive check (capacities PER DEVICE)."""
     adapter = ShardedAdapter(
         cfg, mesh, chunk=chunk, backend=backend, meta_config=meta_config,
         fp_highwater=fp_highwater, pipeline=pipeline,
-        obs_slots=obs_slots,
+        obs_slots=obs_slots, coverage=coverage,
     )
     return supervise(
         adapter,

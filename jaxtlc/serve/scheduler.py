@@ -441,6 +441,22 @@ class Scheduler:
         res = dict(verdict=outcome.verdict,
                    exit_code=outcome.exit_code, engine="supervised",
                    transcript=out.getvalue())
+        if kw.get("coverage"):
+            # per-job coverage artifact (ISSUE 11): the cumulative
+            # site table folded from the job journal's coverage
+            # events - GET /jobs/<id> returns it, and the journal
+            # itself stays queryable via /coverage?run=<job id>
+            try:
+                from ..obs.coverage import coverage_from_events
+                from ..obs.journal import read as read_journal
+
+                cov = coverage_from_events(
+                    read_journal(req.journal, validate=False)
+                )
+                if cov is not None:
+                    res["coverage"] = cov
+            except (OSError, ValueError):
+                pass  # a sick journal must not mask the verdict
         if r is not None:
             res.update(
                 generated=r.generated, distinct=r.distinct,

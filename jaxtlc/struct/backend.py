@@ -89,7 +89,8 @@ def _card_specs(cdc: StructCodec, variables, card_bounds) -> list:
 def struct_backend(model: StructModel,
                    check_deadlock: bool = True,
                    bounds=None,
-                   elide: bool = True) -> SpecBackend:
+                   elide: bool = True,
+                   coverage: bool = False) -> SpecBackend:
     """Compile `model` into a SpecBackend: parse -> shape-infer ->
     lane-compile, the pipeline struct.cache memoizes in-process.
 
@@ -105,7 +106,17 @@ def struct_backend(model: StructModel,
     narrowing real states away.  `elide=False` narrows the codec but
     keeps every trap and carries no certificate (the mesh-sharded
     engines, which have no certificate column: the encode traps stay
-    the soundness story there)."""
+    the soundness story there).
+
+    `coverage` compiles the device coverage plane in (ISSUE 11): the
+    lane walker assigns a stable site id to every guard conjunct,
+    branch arm, action-position binder body and update conjunct, and
+    the backend exposes an obs.coverage.CoveragePlane whose count hook
+    the engines fold into the cumulative per-site counter leaf.  The
+    site table opens with one "action" site per action (the PR 3
+    per-action coverage lines are a prefix view of per-site coverage).
+    Pure telemetry: coverage-on results are bit-for-bit coverage-off
+    results."""
     system = model.system
     trap_policy = None
     cert = False
@@ -163,6 +174,45 @@ def struct_backend(model: StructModel,
             cdc, _card_specs(cdc, system.variables, bounds.card_bounds)
         )
 
+    plane = None
+    if coverage:
+        from ..obs.coverage import (
+            CoveragePlane,
+            Site,
+            action_site_table,
+        )
+
+        cov_fn = compiler.build_cov(system.next_ast)
+        # discover the site table with a shape-only trace (the same
+        # discipline as the label discovery above)
+        jax.eval_shape(
+            cov_fn,
+            jax.ShapeDtypeStruct((1, F), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.bool_),
+            jax.ShapeDtypeStruct((1, len(labels)), jnp.bool_),
+        )
+        fine_sites = tuple(
+            Site(key=k, kind=kind, action=a, loc=desc)
+            for k, kind, a, desc in compiler.cov.sites
+        )
+        sites = tuple(action_site_table(model.root_name, action_names)
+                      ) + fine_sites
+        label_ids = jnp.arange(len(action_names), dtype=jnp.int32)
+
+        def cov_count(batch, mask, valid):
+            # action-prefix sites = per-action generated counts, the
+            # same [L, n_actions] fold the engine's gen counters use -
+            # one accounting, two renderings
+            lane_counts = valid.sum(axis=0).astype(jnp.uint32)
+            act = (
+                (lane_action[:, None] == label_ids[None, :])
+                * lane_counts[:, None]
+            ).sum(axis=0).astype(jnp.uint32)
+            return jnp.concatenate([act, cov_fn(batch, mask, valid)])
+
+        plane = CoveragePlane(sites=sites, count=cov_count,
+                              module=model.root_name)
+
     viol_names = struct_viol_names(model)
     if bounds is not None:
         from ..engine.bfs import VIOL_SLOT_OVERFLOW
@@ -187,6 +237,7 @@ def struct_backend(model: StructModel,
         lane_action=lane_action,
         check_deadlock=check_deadlock,
         cert_check=cert_check,
+        coverage=plane,
     )
     # trap-audit surface (preflight renders which traps remain and why)
     backend.cdc.trap_stats = trap_stats

@@ -181,22 +181,25 @@ def _bounds_key(bounds) -> str:
 
 
 def get_backend(model, check_deadlock: bool = True, bounds=None,
-                elide: bool = True):
+                elide: bool = True, coverage: bool = False):
     """Memoized struct_backend (the parse -> shape-infer -> lane-compile
     pipeline runs once per spec meaning per process).  `bounds` (a
     certified analysis.absint.BoundReport) selects the NARROWED
     compile - a distinct memo entry keyed on the bound digest;
     `elide=False` keeps every trap (the sharded engines' narrowed
-    form, which has no certificate column)."""
+    form, which has no certificate column).  `coverage` compiles the
+    device coverage plane in (a distinct memo entry: the backend
+    carries the site table + count hook)."""
     from .backend import struct_backend
 
     enable_persistent_cache()
     key = (model_key(model), bool(check_deadlock), _bounds_key(bounds),
-           bool(elide))
+           bool(elide), bool(coverage))
     hit = _BACKEND_MEMO.get(key)
     if hit is None:
         hit = struct_backend(model, check_deadlock=check_deadlock,
-                             bounds=bounds, elide=elide)
+                             bounds=bounds, elide=elide,
+                             coverage=coverage)
         _BACKEND_MEMO.put(key, hit)
     return hit
 
@@ -213,17 +216,20 @@ def engine_key(
     pipeline: bool = False,
     obs_slots: int = 0,
     bounds=None,
+    coverage: bool = False,
 ) -> tuple:
     """The full engine-memo key: spec meaning (digest + canonical
-    constants + invariants) x engine geometry x pipeline/obs flags x
-    the certified-bound digest (a narrowed engine is a DIFFERENT
-    compile - its codec, lanes and traps all change with the bounds).
-    The serve EnginePool keys its warm AOT entries on exactly this
-    tuple so pool identity and memo identity cannot drift."""
+    constants + invariants) x engine geometry x pipeline/obs/coverage
+    flags x the certified-bound digest (a narrowed engine is a
+    DIFFERENT compile - its codec, lanes and traps all change with the
+    bounds; a covered engine carries the coverage leaves).  The serve
+    EnginePool keys its warm AOT entries on exactly this tuple so pool
+    identity and memo identity cannot drift."""
     return (
         model_key(model), "single", chunk, queue_capacity, fp_capacity,
         fp_index, seed, fp_highwater, bool(check_deadlock),
         bool(pipeline), int(obs_slots), _bounds_key(bounds),
+        bool(coverage),
     )
 
 
@@ -239,6 +245,7 @@ def get_engine(
     pipeline: bool = False,
     obs_slots: int = 0,
     bounds=None,
+    coverage: bool = False,
 ) -> Tuple:
     """Memoized single-device engine triple (init_fn, run_fn, step_fn)
     for a struct model; enables the persistent XLA cache as a side
@@ -246,18 +253,20 @@ def get_engine(
     part of the key: the ring changes the carry pytree, so an obs-on
     engine is a different compile than an obs-off one.  `bounds`
     selects the narrowed engine (certificate check on, keyed on the
-    bound digest)."""
+    bound digest); `coverage` the covered engine (per-site counter
+    leaves on the carry)."""
     from ..engine.bfs import make_backend_engine
 
     enable_persistent_cache()
     key = engine_key(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
-        obs_slots=obs_slots, bounds=bounds,
+        obs_slots=obs_slots, bounds=bounds, coverage=coverage,
     )
     hit = _ENGINE_MEMO.get(key)
     if hit is None:
-        backend = get_backend(model, check_deadlock, bounds=bounds)
+        backend = get_backend(model, check_deadlock, bounds=bounds,
+                              coverage=coverage)
         hit = make_backend_engine(
             backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
             fp_highwater=fp_highwater, pipeline=pipeline,

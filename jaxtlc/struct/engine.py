@@ -54,17 +54,20 @@ def check_struct(
     pipeline: bool = False,
     obs_slots: int = 0,
     bounds=None,
+    coverage: bool = False,
 ) -> CheckResult:
     """Exhaustive device check of a struct-compiled spec (single device,
     fused loop; AOT-compiled before timing like bfs.check).  `bounds`
     (a certified analysis.absint.BoundReport) runs the NARROWED engine
-    with the runtime certificate check on."""
+    with the runtime certificate check on; `coverage` the covered
+    engine (device per-site coverage on CheckResult.site_coverage)."""
     init_fn, run_fn, _ = get_engine(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
-        obs_slots=obs_slots, bounds=bounds,
+        obs_slots=obs_slots, bounds=bounds, coverage=coverage,
     )
-    backend = get_backend(model, check_deadlock, bounds=bounds)
+    backend = get_backend(model, check_deadlock, bounds=bounds,
+                          coverage=coverage)
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()
     t0 = time.time()
@@ -73,6 +76,7 @@ def check_struct(
     return result_from_carry(
         out, wall, fp_capacity=fp_capacity, labels=backend.labels,
         viol_names=backend.viol_names,
+        sites=backend.coverage.sites if backend.coverage else None,
     )
 
 
@@ -87,17 +91,19 @@ def check_struct_sharded(
     pipeline: bool = False,
     obs_slots: int = 0,
     bounds=None,
+    coverage: bool = False,
 ) -> CheckResult:
     """Exhaustive mesh-sharded check of a struct-compiled spec
     (capacities PER DEVICE; fingerprint-space all_to_all partitioning,
     psum-reduced counters - engine.sharded, same backend seam).
     `bounds` narrows the codec; the mesh engine has no certificate
     column yet, so every trap stays compiled in (elide=False) and the
-    encode traps carry the soundness story there."""
+    encode traps carry the soundness story there.  `coverage` carries
+    the per-device coverage partials, summed at readback."""
     from ..engine.sharded import check_sharded
 
     backend = get_backend(model, check_deadlock, bounds=bounds,
-                          elide=False)
+                          elide=False, coverage=coverage)
     return check_sharded(
         None, mesh, chunk=chunk, queue_capacity=queue_capacity,
         fp_capacity=fp_capacity, route_factor=route_factor,

@@ -541,8 +541,8 @@ def test_selfcheck_registry_pinned():
     from jaxtlc.analysis.selfcheck import FACTORIES
 
     assert sorted(FACTORIES) == [
-        "enumerator", "fused", "narrowed", "phased", "pipelined",
-        "sharded", "spill", "struct", "sweep",
+        "covered", "enumerator", "fused", "narrowed", "phased",
+        "pipelined", "sharded", "spill", "struct", "sweep",
     ]
 
 

@@ -1,0 +1,536 @@
+"""Device-resident live coverage plane (ISSUE 11).
+
+Per-site coverage counters compiled into the kernels, accumulated in
+the engine carry, streamed over the serve plane, and pinned against
+the host coverage-walker oracle:
+
+* FF device-vs-host-walker SITE-FOR-SITE parity (the KubeAPI plane's
+  311 tracked span keys vs spec.coverage's instrumented re-walk);
+* checkpoint -> SIGTERM -> -recover coverage continuity as ONE journal
+  stream, regrow migration, sharded 2-device psum parity, pipelined
+  parity - every path lands the identical site table;
+* GET /coverage + Prometheus coverage_site_total + tlcstat render +
+  the saturation signal, all derived views of the same journal events;
+* the struct compiler's site table (action-prefix contract, device
+  dump, dead-site lint, covdiff artifact round-trip).
+
+Budget discipline (tier-1 runs ~800 s of its 870 s budget): ONE module-
+scoped FF coverage engine + ONE host walk are shared by every KubeAPI
+test; supervised runs reuse the same tiny geometry; the struct tests
+share the TwoPhase covered backend with the selfcheck "covered"
+factory through the struct.cache memo.  Model_1 parity is slow-marked.
+"""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from jaxtlc.config import MODEL_1, ModelConfig
+from jaxtlc.engine.backend import kubeapi_backend
+from jaxtlc.engine.bfs import check
+from jaxtlc.obs.coverage import coverage_from_events
+from jaxtlc.obs.journal import RunJournal, read as read_journal
+from jaxtlc.resil import SupervisorOptions, check_supervised
+from jaxtlc.resil.faults import FaultPlan
+
+FF = ModelConfig(False, False)
+GEO = dict(chunk=256, queue_capacity=1 << 12, fp_capacity=1 << 14)
+FF_EXPECT = (17020, 8203, 109)
+
+MC_OUT = "/root/reference/KubeAPI.toolbox/Model_1/MC.out"
+needs_reference = pytest.mark.skipif(
+    not os.path.exists(MC_OUT), reason="reference toolbox not mounted"
+)
+
+
+@pytest.fixture(scope="module")
+def ff_plane():
+    return kubeapi_backend(FF, coverage=True).coverage
+
+
+@pytest.fixture(scope="module")
+def ff_host_cov():
+    from jaxtlc.spec.coverage import run_coverage
+
+    return run_coverage(FF)
+
+
+@pytest.fixture(scope="module")
+def ff_device_run():
+    r = check(FF, coverage=True, **GEO)
+    assert (r.generated, r.distinct, r.depth) == FF_EXPECT
+    return r
+
+
+def _sup_journal(tmpdir, name, **opts):
+    """A supervised FF coverage run journaling into tmpdir; returns
+    (SupervisedResult, journal path)."""
+    jpath = os.path.join(str(tmpdir), f"{name}.journal.jsonl")
+    resume = opts.pop("resume", False)
+    j = RunJournal(jpath, resume=resume)
+    if resume:
+        j.event("run_resume", version="t", path=jpath)
+    else:
+        j.event("run_start", version="t", workload="FF",
+                engine="single", device="cpu", params={})
+    sup = check_supervised(
+        FF, obs_slots=32, coverage=True, **GEO,
+        opts=SupervisorOptions(
+            ckpt_path=os.path.join(str(tmpdir), f"{name}.npz"),
+            ckpt_every=16, resume=resume,
+            on_event=lambda kind, info: j.event(kind, **info),
+            **opts,
+        ),
+    )
+    j.close()
+    return sup, jpath
+
+
+# ---------------------------------------------------------------------------
+# FF: device vs host-walker oracle, site for site
+# ---------------------------------------------------------------------------
+
+
+def test_ff_device_matches_host_walker_site_for_site(
+    ff_plane, ff_host_cov, ff_device_run
+):
+    """Every tracked site's device count equals the instrumented host
+    re-walk's - action sites against per-action generated, span sites
+    against the walker's visit counters, Init sites against the
+    walker's Init accounting.  311 sites, zero tolerance."""
+    host = ff_host_cov
+    assert (host.generated, host.distinct, host.depth) == FF_EXPECT
+    cov = ff_device_run.site_coverage
+    assert len(cov) == len(ff_plane.sites) >= 300
+    bad = []
+    for s in ff_plane.sites:
+        want = (host.act_gen.get(s.key, 0) if s.kind == "action"
+                else host.cov.n.get(s.key, 0))
+        if cov[s.key] != want:
+            bad.append((s.key, s.kind, cov[s.key], want))
+    assert not bad, bad[:20]
+    # the tracked table is not vacuous: most sites fired on FF
+    visited = sum(1 for v in cov.values() if v)
+    assert visited >= 0.9 * len(cov)
+
+
+def test_ff_action_prefix_is_generated_counters(ff_plane, ff_device_run):
+    """The per-action sites open the table (prefix-view contract) and
+    equal the engine's own per-action generated counters - one
+    accounting behind both renderers."""
+    from jaxtlc.spec.labels import LABELS
+
+    prefix = [s for s in ff_plane.sites[: len(LABELS)]]
+    assert [s.key for s in prefix] == list(LABELS)
+    assert all(s.kind == "action" for s in prefix)
+    for s in prefix:
+        assert ff_device_run.site_coverage[s.key] == \
+            ff_device_run.action_generated.get(s.key, 0), s.key
+
+
+# ---------------------------------------------------------------------------
+# supervised: journal events, serve plane, saturation, continuity
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def sup_run(tmp_path_factory, ff_device_run):
+    tmpdir = tmp_path_factory.mktemp("cov")
+    sup, jpath = _sup_journal(tmpdir, "clean")
+    assert not sup.interrupted
+    assert sup.result.site_coverage == ff_device_run.site_coverage
+    return sup, jpath
+
+
+def test_supervised_journal_folds_to_carry_totals(sup_run, ff_device_run):
+    sup, jpath = sup_run
+    events = read_journal(jpath)  # schema-validates every line
+    cov_events = [e for e in events if e["event"] == "coverage"]
+    assert cov_events, "no coverage events journaled"
+    folded = coverage_from_events(events)
+    assert folded["sites"] == {
+        k: v for k, v in ff_device_run.site_coverage.items() if v
+    } or folded["sites"] == ff_device_run.site_coverage
+    # deltas only ever add (cumulative counters)
+    for e in cov_events:
+        assert all(d > 0 for d in e["delta"].values()) or e.get(
+            "saturated"
+        )
+
+
+def test_supervised_saturation_signal(sup_run):
+    """FF visits its last new site long before level 109: the 'no new
+    site for N levels' event fires exactly once."""
+    _, jpath = sup_run
+    sat = [e for e in read_journal(jpath)
+           if e["event"] == "coverage" and e.get("saturated")]
+    assert len(sat) == 1
+    assert sat[0]["level"] > 0 and sat[0]["visited"] > 250
+
+
+def test_serve_coverage_endpoint_prometheus_tlcstat(sup_run):
+    """GET /coverage (JSON), the coverage_site_total Prometheus
+    counters, the seek-tail SSE stream and tlcstat's coverage line all
+    render the same journal."""
+    from jaxtlc.obs.serve import _http_get, start_server
+
+    _, jpath = sup_run
+    events = read_journal(jpath)
+    folded = coverage_from_events(events)
+    srv = start_server(os.path.dirname(jpath))
+    try:
+        body = json.loads(_http_get(srv.url + "/coverage"))
+        assert body["sites"] == folded["sites"]
+        assert body["visited"] == folded["visited"]
+        met = _http_get(srv.url + "/metrics")
+        assert 'jaxtlc_coverage_site_total{site="APIStart"}' in met
+        assert "jaxtlc_coverage_visited" in met
+        # seek-tail SSE: every event exactly once, torn-line safe
+        sse = _http_get(srv.url + "/events?once=1")
+        assert sse.count("data: ") == len(events)
+        runs = json.loads(_http_get(srv.url + "/runs"))["runs"]
+        assert runs and runs[0]["events"] == len(events)
+        # second hit comes from the (path, mtime, size) cache
+        runs2 = json.loads(_http_get(srv.url + "/runs"))["runs"]
+        assert runs2 == runs
+    finally:
+        srv.shutdown()
+    import importlib
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    tlcstat = importlib.import_module("tlcstat")
+    frame = tlcstat.render(events)
+    assert "coverage:" in frame and "SATURATED" in frame
+
+
+def test_sse_seek_tail_holds_back_torn_line(tmp_path):
+    """The _JournalTail contract: a trailing line without its newline
+    is held back until the writer completes it - never emitted
+    partial, never emitted twice."""
+    from jaxtlc.obs.serve import _JournalTail
+
+    p = os.path.join(str(tmp_path), "t.jsonl")
+    with open(p, "w") as f:
+        f.write('{"a": 1}\n{"b": 2')
+        f.flush()
+        tail = _JournalTail(p)
+        assert tail.poll() == [{"a": 1}]
+        assert tail.poll() == []  # torn line held back
+        f.write('}\n')
+        f.flush()
+    assert tail.poll() == [{"b": 2}]
+    assert tail.poll() == []
+
+
+def test_sigterm_recover_coverage_continuity(tmp_path, ff_device_run):
+    """checkpoint -> SIGTERM -> -recover: the journal is ONE stream
+    whose folded coverage equals the uninterrupted run's, with no
+    duplicated deltas across the interrupt boundary."""
+    sup1, jpath = _sup_journal(tmp_path, "kill",
+                               faults=FaultPlan.parse("sigterm@2"))
+    assert sup1.interrupted and not sup1.exhausted
+    sup2, _ = _sup_journal(tmp_path, "kill", resume=True)
+    assert not sup2.interrupted
+    r = sup2.result
+    assert (r.generated, r.distinct, r.depth) == FF_EXPECT
+    assert r.site_coverage == ff_device_run.site_coverage
+    events = read_journal(jpath)
+    assert sum(1 for e in events if e["event"] == "run_resume") == 1
+    folded = coverage_from_events(events)
+    for k, v in folded["sites"].items():
+        assert v == ff_device_run.site_coverage[k], k
+
+
+def test_regrow_migrates_coverage_verbatim(ff_plane):
+    """Regrow migration carries the coverage counters verbatim into
+    the doubled geometry (unit-level through the production
+    migrate_engine_carry - a full regrow replay would cost another
+    engine compile against the tier-1 budget; the sigterm/recover
+    test above already replays segments through the supervisor)."""
+    from jaxtlc.engine.bfs import make_backend_engine
+    from jaxtlc.resil.regrow import migrate_engine_carry
+
+    backend = kubeapi_backend(FF, coverage=True)
+    init_fn, _, step_fn = make_backend_engine(
+        backend, chunk=64, queue_capacity=1 << 10,
+        fp_capacity=1 << 12, donate=False,
+    )
+    carry = step_fn(step_fn(init_fn()))
+    old = {"queue_capacity": 1 << 10, "fp_capacity": 1 << 12}
+    new = {"queue_capacity": 1 << 11, "fp_capacity": 1 << 13}
+    migrated = migrate_engine_carry(carry, old, new)
+    assert migrated.cov_counts is not None
+    assert (np.asarray(migrated.cov_counts)
+            == np.asarray(carry.cov_counts)).all()
+    # stepping the migrated carry in the new geometry keeps counting
+    init2, _, step2 = make_backend_engine(
+        backend, chunk=64, **new, donate=False,
+    )
+    after = step2(migrated)
+    assert int(np.asarray(after.cov_counts).sum()) >= int(
+        np.asarray(carry.cov_counts).sum())
+
+
+def test_sharded_2dev_psum_parity(ff_device_run):
+    """2-device mesh: per-device coverage partials sum to exactly the
+    single-device table (the psum-merge contract)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from jaxtlc.engine.sharded import check_sharded
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("fp",))
+    rs = check_sharded(
+        FF, mesh, chunk=128, queue_capacity=1 << 12,
+        fp_capacity=1 << 14,
+        backend=kubeapi_backend(FF, coverage=True),
+    )
+    assert (rs.generated, rs.distinct, rs.depth) == FF_EXPECT
+    assert rs.site_coverage == ff_device_run.site_coverage
+
+
+def test_checkpoint_meta_records_coverage(tmp_path):
+    """A covered checkpoint cannot silently resume into an uncovered
+    engine: the meta carries the flag and mismatches loudly."""
+    from jaxtlc.resil.supervisor import (
+        SingleDeviceAdapter,
+        _params_from_meta,
+    )
+
+    ad_cov = SingleDeviceAdapter(FF, chunk=256, coverage=True)
+    ad_plain = SingleDeviceAdapter(FF, chunk=256)
+    params = {"queue_capacity": 1 << 12, "fp_capacity": 1 << 14}
+    meta = ad_cov.meta(params)
+    assert meta["coverage"] is True
+    with pytest.raises(ValueError, match="coverage"):
+        _params_from_meta(ad_plain, meta, params)
+    # pre-coverage snapshots (no key) resume into uncovered engines
+    old = {k: v for k, v in ad_plain.meta(params).items()
+           if k != "coverage"}
+    assert _params_from_meta(ad_plain, old, params)
+
+
+# ---------------------------------------------------------------------------
+# struct plane: site table, dump, dead-site lint, covdiff
+# ---------------------------------------------------------------------------
+
+
+SPECS = os.path.join(os.path.dirname(__file__), os.pardir, "specs")
+TP_CFG = os.path.join(SPECS, "TwoPhase.toolbox", "Model_1", "MC.cfg")
+
+
+@pytest.fixture(scope="module")
+def twophase_cov():
+    """One tiny covered TwoPhase run (check_deadlock off so the run is
+    clean); the backend is shared with the selfcheck 'covered' factory
+    through the struct.cache memo."""
+    from jaxtlc.struct.cache import get_backend
+    from jaxtlc.struct.engine import check_struct
+    from jaxtlc.struct.loader import load
+
+    model = load(TP_CFG)
+    r = check_struct(model, chunk=64, queue_capacity=1 << 10,
+                     fp_capacity=1 << 12, check_deadlock=False,
+                     coverage=True)
+    assert r.violation == 0
+    backend = get_backend(model, False, coverage=True)
+    return model, backend, r
+
+
+def test_struct_site_table_and_prefix(twophase_cov):
+    model, backend, r = twophase_cov
+    plane = backend.coverage
+    n_actions = len(backend.labels)
+    prefix = plane.sites[:n_actions]
+    assert tuple(s.key for s in prefix) == backend.labels
+    for s in prefix:
+        assert r.site_coverage[s.key] == r.action_generated.get(
+            s.key, 0), s.key
+    kinds = {s.kind for s in plane.sites[n_actions:]}
+    # the walker instruments all four construct classes on TwoPhase
+    assert {"guard", "effect", "unchanged", "quant"} <= kinds
+    # guard sites respect short-circuit reach: a second conjunct never
+    # logs more visits than the first
+    by_action = {}
+    for s in plane.sites[n_actions:]:
+        if s.kind == "guard":
+            by_action.setdefault(s.action, []).append(
+                r.site_coverage[s.key])
+    for action, counts in by_action.items():
+        assert counts == sorted(counts, reverse=True), (action, counts)
+
+
+def test_struct_coverage_deterministic_and_pure(twophase_cov):
+    """Coverage is telemetry: the covered run's verdict/counts equal
+    the uncovered engine's, and a second covered run lands the
+    identical table."""
+    from jaxtlc.struct.engine import check_struct
+
+    model, _backend, r = twophase_cov
+    r_plain = check_struct(model, chunk=64, queue_capacity=1 << 10,
+                           fp_capacity=1 << 12, check_deadlock=False)
+    assert (r.generated, r.distinct, r.depth) == (
+        r_plain.generated, r_plain.distinct, r_plain.depth)
+    r2 = check_struct(model, chunk=64, queue_capacity=1 << 10,
+                      fp_capacity=1 << 12, check_deadlock=False,
+                      coverage=True)
+    assert r2.site_coverage == r.site_coverage
+
+
+def test_struct_device_dump_and_covdiff(twophase_cov, tmp_path):
+    """The MC.out-format device dump renders every action header +
+    span line, and covdiff round-trips the artifact with no
+    self-regression / flags a seeded one."""
+    from jaxtlc.obs.coverage import render_site_dump
+
+    model, backend, r = twophase_cov
+    plane = backend.coverage
+    counts = [r.site_coverage[s.key] for s in plane.sites]
+    lines = render_site_dump(
+        plane.sites, counts, plane.module, "STAMP", init_count=2,
+        act_gen=r.action_generated, act_dist=r.action_distinct,
+    )
+    assert lines[0].startswith("The coverage statistics at")
+    assert any(l.startswith("<Init of module") for l in lines)
+    heads = [l for l in lines if l.startswith("<") and "Init" not in l]
+    # every action gets a header (plus the "?" group for sites walked
+    # before a lane label resolves - the pre-label \E binder)
+    for a in backend.labels:
+        assert any(h.startswith(f"<{a} ") for h in heads), a
+    assert any(l.startswith("  |") for l in lines)
+
+    import importlib
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    covdiff = importlib.import_module("covdiff")
+    art = os.path.join(str(tmp_path), "cov.json")
+    json.dump({"sites": r.site_coverage}, open(art, "w"))
+    assert covdiff.main([art, art]) == 0
+    seeded = dict(r.site_coverage)
+    fired = next(k for k, v in r.site_coverage.items() if v)
+    seeded[fired] = 0
+    bad = os.path.join(str(tmp_path), "bad.json")
+    json.dump({"sites": seeded}, open(bad, "w"))
+    assert covdiff.main([bad, art]) == 1  # regression: fired -> zero
+
+
+def test_dead_site_lint_flags_reachable_zero_sites(twophase_cov):
+    """A zero-visit site of a statically-reachable action becomes a
+    warning-severity analysis event; statically-unreachable actions
+    (the PR 6 lint's findings) are excluded."""
+    from jaxtlc.api import _struct_dead_sites
+
+    model, backend, r = twophase_cov
+
+    class _Spec:
+        check_deadlock = False
+
+    class _Args:
+        pass
+
+    fired = next(k for k, v in r.site_coverage.items()
+                 if v and "." in k)
+    seeded = dict(r.site_coverage)
+    seeded[fired] = 0
+    r_seeded = r._replace(site_coverage=seeded)
+    events = _struct_dead_sites(_Args(), _Spec(), model, None, r_seeded)
+    assert any(e["subject"] == fired for e in events), events
+    for e in events:
+        assert e["severity"] == "warning"
+        assert e["check"] == "dead-site"
+    # a clean table with every site visited lints nothing
+    full = {k: max(v, 1) for k, v in r.site_coverage.items()}
+    assert _struct_dead_sites(
+        _Args(), _Spec(), model, None, r._replace(site_coverage=full)
+    ) == []
+
+
+def test_cli_coverage_dump_via_api(twophase_cov, tmp_path):
+    """`-coverage` end to end on the struct path: run_check renders
+    the device dump (no host re-walk) and journals coverage events;
+    the engine comes from the SAME memo as the fixture (zero fresh
+    compiles)."""
+    from jaxtlc.api import CheckRequest, run_check
+
+    out = io.StringIO()
+    req = CheckRequest(
+        config=TP_CFG, workers="cpu", chunk=64, qcap=1 << 10,
+        fpcap=1 << 12, autogrow=False, nodeadlock=True, coverage=True,
+        noTool=True, journal=os.path.join(str(tmp_path), "tp.jsonl"),
+        out=out, err=out,
+    )
+    outcome = run_check(req)
+    assert outcome.exit_code == 0, out.getvalue()
+    text = out.getvalue()
+    assert "The coverage statistics at" in text
+    assert "<CallOff of module" in text
+    folded = coverage_from_events(read_journal(outcome.journal_path))
+    _model, _backend, r = twophase_cov
+    for k, v in folded["sites"].items():
+        assert r.site_coverage[k] == v, k
+
+
+def test_coverage_saturation_derived_view_synthetic():
+    """The derived view folds delta events without an engine: totals,
+    visited counts, the saturation marker."""
+    evs = [
+        {"event": "coverage", "visited": 2, "sites": 3,
+         "delta": {"A": 5, "B": 1}},
+        {"event": "coverage", "visited": 2, "sites": 3,
+         "delta": {"A": 2}},
+        {"event": "coverage", "visited": 2, "sites": 3, "delta": {},
+         "saturated": True, "level": 9},
+    ]
+    cov = coverage_from_events(evs)
+    assert cov["sites"] == {"A": 7, "B": 1}
+    assert cov["visited"] == 2 and cov["n_sites"] == 3
+    assert cov["saturated_at_level"] == 9
+    assert coverage_from_events([{"event": "final"}]) is None
+
+
+# ---------------------------------------------------------------------------
+# Model_1 (slow): the full-scale pin
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_model1_device_matches_host_walker_site_for_site():
+    from jaxtlc.spec.coverage import run_coverage
+
+    host = run_coverage(MODEL_1)
+    plane = kubeapi_backend(MODEL_1, coverage=True).coverage
+    r = check(MODEL_1, chunk=1024, queue_capacity=1 << 15,
+              fp_capacity=1 << 20, coverage=True)
+    assert (r.generated, r.distinct, r.depth) == (577736, 163408, 124)
+    bad = []
+    for s in plane.sites:
+        want = (host.act_gen.get(s.key, 0) if s.kind == "action"
+                else host.cov.n.get(s.key, 0))
+        if r.site_coverage[s.key] != want:
+            bad.append((s.key, r.site_coverage[s.key], want))
+    assert not bad, bad[:20]
+
+
+@pytest.mark.slow
+@needs_reference
+def test_model1_device_counts_diff_clean_against_mc_out(tmp_path):
+    """covdiff against the committed reference dump: the device table
+    reports no regression vs MC.out's coverage section."""
+    import importlib
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    covdiff = importlib.import_module("covdiff")
+    r = check(MODEL_1, chunk=1024, queue_capacity=1 << 15,
+              fp_capacity=1 << 20, coverage=True)
+    art = os.path.join(str(tmp_path), "m1.json")
+    json.dump({"sites": r.site_coverage}, open(art, "w"))
+    assert covdiff.main([art, MC_OUT]) == 0

@@ -55,6 +55,15 @@ def test_profile_v4_tiny_smoke(capsys):
         assert needle in out, f"profiler output lost {needle!r}:\n{out}"
 
 
+def test_covdiff_tiny_smoke(capsys):
+    """tools/covdiff.py --tiny: regression detection + JSON-artifact
+    round-trip on synthetic coverage tables (no engine run)."""
+    mod = _load_tool("covdiff")
+    assert mod.main(["--tiny"]) == 0
+    out = capsys.readouterr().out
+    assert "covdiff tiny OK" in out
+
+
 def test_tlcstat_tiny_smoke(capsys):
     """tlcstat --tiny renders a full dashboard frame from a synthetic
     journal (rates, occupancy, ETA, verdict) - the whole read/render

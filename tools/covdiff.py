@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""covdiff: diff device coverage against an oracle or a prior run.
+
+CI tooling for the device coverage plane (ISSUE 11): compares the
+per-site visit counts of a run against a baseline and exits nonzero on
+a COVERAGE REGRESSION - a site the baseline visited that the current
+run never reached (the "we stopped exercising that behavior" signal;
+raw count drift between runs of different sizes is reported but not
+fatal unless --exact).
+
+    python tools/covdiff.py CURRENT BASELINE [--exact]
+    python tools/covdiff.py --tiny          # tier-1 self-test
+
+Accepted formats for either side (sniffed by content):
+  * a run journal (*.jsonl) - the `coverage` delta events fold into
+    cumulative totals (obs.coverage.coverage_from_events);
+  * a JSON artifact {"sites": {key: count, ...}} (GET /coverage body,
+    or a previously saved covdiff --save);
+  * a committed TLC MC.out - the coverage section's span lines are
+    mapped back to span keys through the generated span table
+    (jaxtlc/spec/coverage_spans.py), so the device counters diff
+    directly against the reference dump.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, Optional
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+)
+
+_ACTION_RE = re.compile(r"^<(\w+) line .*?>: (\d+):(\d+)$")
+_SPAN_RE = re.compile(r"^\s*\|*(line .*? to line .*?) of module \w+: "
+                      r"(\d+)(?::\d+)?$")
+
+
+def _load_mc_out(path: str) -> Dict[str, int]:
+    """{site key: count} from a TLC MC.out coverage section, keyed via
+    the generated span table (loc -> key)."""
+    from jaxtlc.spec.coverage_spans import SPANS
+
+    loc_key = {}
+    for _name, _code, _loc, lines in SPANS:
+        for _dep, loc, key, _lcode, _hc, _ce in lines:
+            loc_key.setdefault(loc, key)
+    out: Dict[str, int] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for raw in f:
+            line = raw.rstrip("\n")
+            m = _ACTION_RE.match(line.strip())
+            if m:
+                out[m.group(1)] = int(m.group(3))  # generated count
+                continue
+            m = _SPAN_RE.match(line)
+            if m and m.group(1) in loc_key:
+                key = loc_key[m.group(1)]
+                if key not in out:  # first (outermost) pairing wins
+                    out[key] = int(m.group(2))
+    return out
+
+
+def load_sites(path: str) -> Optional[Dict[str, int]]:
+    """Sniff + load a coverage table from any supported format."""
+    with open(path, "r", encoding="utf-8") as f:
+        head = f.read(4096)
+    if "@!@!@STARTMSG" in head or "TLC2" in head:
+        return _load_mc_out(path)
+    if path.endswith(".jsonl") or head.lstrip().startswith('{"'):
+        # journal (one JSON object per line) vs artifact (one object)
+        try:
+            obj = json.load(open(path, "r", encoding="utf-8"))
+            if isinstance(obj, dict) and "sites" in obj:
+                return {k: int(v) for k, v in obj["sites"].items()}
+        except json.JSONDecodeError:
+            pass
+        from jaxtlc.obs import journal as jr
+        from jaxtlc.obs.coverage import coverage_from_events
+
+        cov = coverage_from_events(jr.read(path, validate=False))
+        return cov["sites"] if cov else None
+    return None
+
+
+def diff(cur: Dict[str, int], base: Dict[str, int],
+         exact: bool = False):
+    """(regressions, drifts, news): sites the baseline visited that the
+    run never reached / count changes / newly visited sites."""
+    regressions, drifts, news = [], [], []
+    for k, b in sorted(base.items()):
+        c = cur.get(k, 0)
+        if b > 0 and c == 0:
+            regressions.append((k, c, b))
+        elif c != b:
+            drifts.append((k, c, b))
+    for k, c in sorted(cur.items()):
+        if c > 0 and base.get(k, 0) == 0:
+            news.append((k, c))
+    if exact:
+        regressions = regressions + drifts
+        drifts = []
+    return regressions, drifts, news
+
+
+def _tiny() -> int:
+    """Self-test: a synthetic artifact pair must flag exactly the
+    seeded regression (wired into tier-1 via tests/test_tools.py)."""
+    base = {"A": 10, "A.g0": 10, "A.w0": 8, "B": 3, "B.g0": 3}
+    cur_ok = {"A": 12, "A.g0": 12, "A.w0": 9, "B": 5, "B.g0": 5}
+    cur_bad = {"A": 12, "A.g0": 12, "A.w0": 9, "B": 0, "B.g0": 0}
+    r, d, n = diff(cur_ok, base)
+    assert not r and len(d) == 5 and not n, (r, d, n)
+    r, d, n = diff(cur_bad, base)
+    assert [k for k, *_ in r] == ["B", "B.g0"], r
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "cov.json")
+        json.dump({"sites": base}, open(p, "w"))
+        assert load_sites(p) == base
+    print("covdiff tiny OK: regression detection + artifact round-trip")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="covdiff")
+    p.add_argument("current", nargs="?",
+                   help="journal / artifact / MC.out of the run")
+    p.add_argument("baseline", nargs="?",
+                   help="journal / artifact / MC.out to diff against")
+    p.add_argument("--exact", action="store_true",
+                   help="any count change is a regression (same-config "
+                        "pinning), not just visited -> unvisited")
+    p.add_argument("--save", default="",
+                   help="write CURRENT's table as a JSON artifact here")
+    p.add_argument("--tiny", action="store_true",
+                   help="self-test (no inputs; wired into tier-1)")
+    args = p.parse_args(argv)
+    if args.tiny:
+        return _tiny()
+    if not args.current:
+        p.error("current coverage input required (or --tiny)")
+    cur = load_sites(args.current)
+    if cur is None:
+        print(f"covdiff: no coverage data in {args.current!r}",
+              file=sys.stderr)
+        return 2
+    if args.save:
+        with open(args.save, "w", encoding="utf-8") as f:
+            json.dump({"sites": cur}, f, sort_keys=True, indent=1)
+        print(f"covdiff: saved {len(cur)} sites to {args.save}")
+    if not args.baseline:
+        return 0
+    base = load_sites(args.baseline)
+    if base is None:
+        print(f"covdiff: no coverage data in {args.baseline!r}",
+              file=sys.stderr)
+        return 2
+    shared = set(cur) & set(base)
+    regressions, drifts, news = diff(cur, base, exact=args.exact)
+    print(f"covdiff: {len(shared)} shared sites, "
+          f"{len(regressions)} regression(s), {len(drifts)} drift(s), "
+          f"{len(news)} newly visited")
+    for k, c, b in regressions[:50]:
+        print(f"  REGRESSION {k}: {b} -> {c}")
+    for k, c, b in drifts[:10]:
+        print(f"  drift {k}: {b} -> {c}")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,6 +141,18 @@ def render(events) -> str:
         lines.append("phase walls: " + "  ".join(
             f"{k} {v:.3f}s" for k, v in sorted(phases.items())
         ))
+    # device coverage plane (obs.coverage): visited/total sites + the
+    # saturation signal, folded from the journal's coverage deltas
+    from jaxtlc.obs.coverage import coverage_from_events
+
+    cov = coverage_from_events(events)
+    if cov is not None:
+        sat = cov.get("saturated_at_level")
+        lines.append(
+            f"coverage: {cov['visited']}/{cov['n_sites']} sites visited"
+            + (f"  |  SATURATED at level {sat} (no new site since)"
+               if sat is not None else "")
+        )
     last = events[-1]
     age = time.time() - last["t"]
     lines.append(f"last event: {last['event']} ({age:.1f}s ago)")
