@@ -43,6 +43,14 @@ Usage:
                                # full-signature bit-equality gated
                                # (the <= 0.5% acceptance gate of
                                # ISSUE 11)
+    python bench.py --commit-ab  # Model_1 at chunk 2048 with
+                               # -sort-free vs -no-sort-free, AOT
+                               # compiles shared, timed runs
+                               # interleaved best-of-5: sort_ms_saved
+                               # metric line + both rates, full
+                               # signature AND fpset TABLE words
+                               # bit-equality gated (the ISSUE 12
+                               # exactness contract)
 """
 
 import json
@@ -676,6 +684,138 @@ def bench_obs_ab(probe_err: str) -> int:
     return 0
 
 
+def bench_commit_ab(probe_err: str) -> int:
+    """--commit-ab: A/B the sort-free hash-slab commit against the
+    sorted dedup path (the ISSUE 12 acceptance harness).
+
+    Runs Model_1 at chunk 2048 (the regime where the fitted cost model
+    puts the two dedup sorts at 89% of commit, COSTMODEL.json) through
+    BOTH engines - `-no-sort-free` and `-sort-free` - AOT-compiled once
+    each, with the timed runs INTERLEAVED (sorted/slab per repeat,
+    best-of-5): sequential best-of-2 on this CPU shows +-3% phantom
+    effects (PERF.md round 8 methodology note).  Gate: the sort-free
+    run must be BIT-FOR-BIT the sorted run - full signature AND the
+    final fpset TABLE words - or the harness reports failure instead of
+    a number.  Emits a `sort_ms_saved` line (per-step dedup-stage wall
+    saved, from the differential sub-phase profiler at the same chunk)
+    and the rate line carrying both rates.  The CPU wall delta is
+    REPORT-ONLY per the standing tunnel caveat: the acceptance rate
+    gate ("no worse than sorted") is enforced on-chip; the committed
+    COSTMODEL.json carries the CPU sort-ms reduction."""
+    device_note = ""
+    if probe_err:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        device_note = f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
+    import jax
+    import numpy as np
+
+    from jaxtlc.config import MODEL_1
+    from jaxtlc.engine.backend import kubeapi_backend
+    from jaxtlc.engine.bfs import make_engine, result_from_carry
+    from jaxtlc.obs.phases import subphase_walls
+
+    workload = "Model_1"
+    kw = dict(chunk=2048, queue_capacity=1 << 15, fp_capacity=1 << 20)
+    compiled = {}
+    for sf in (False, True):
+        init_fn, run_fn, _ = make_engine(
+            MODEL_1, **kw, donate=False, sort_free=sf,
+        )
+        carry0 = init_fn()
+        compiled[sf] = (run_fn.lower(carry0).compile(), carry0)
+
+    walls = {False: [], True: []}
+    finals = {}
+    for _ in range(5):
+        for sf in (False, True):
+            fn, carry0 = compiled[sf]
+            t0 = time.time()
+            out = jax.block_until_ready(fn(carry0))
+            walls[sf].append(time.time() - t0)
+            finals[sf] = out
+
+    results = {}
+    for sf, out in finals.items():
+        r = result_from_carry(out, min(walls[sf]),
+                              fp_capacity=kw["fp_capacity"])
+        if r.violation or (
+            r.generated, r.distinct, r.depth
+        ) != EXPECT[workload]:
+            _emit({"error": f"sort_free={sf} count mismatch: "
+                            f"{(r.generated, r.distinct, r.depth)}",
+                   "workload": workload, "sort_free": sf})
+            return 1
+        results[sf] = r
+
+    def signature(r):
+        return (r.generated, r.distinct, r.depth, r.violation,
+                tuple(sorted(r.action_generated.items())),
+                tuple(sorted(r.action_distinct.items())),
+                r.outdegree, r.fp_occupancy)
+
+    # exactness is the contract, not a sampling property: the full
+    # signature AND the fingerprint-table words must match
+    if signature(results[False]) != signature(results[True]) or not (
+        np.asarray(finals[False].fps.table)
+        == np.asarray(finals[True].fps.table)
+    ).all():
+        _emit({"error": "sort-free run is not bit-identical to the "
+                        "sorted engine", "workload": workload,
+               "sort_free": True})
+        return 1
+
+    # dedup-stage attribution at the same chunk: the differential
+    # sub-phase profiler's "sort" column in both modes
+    backend = kubeapi_backend(MODEL_1)
+    sort_ms = {}
+    for sf in (False, True):
+        w = subphase_walls(backend, kw["chunk"], kw["queue_capacity"],
+                           kw["fp_capacity"], sort_free=sf)
+        sort_ms[sf] = 1e3 * w["sort"]
+
+    wall_sorted, wall_free = min(walls[False]), min(walls[True])
+    rate_free = results[True].distinct / wall_free
+    rate_sorted = results[False].distinct / wall_sorted
+    device = str(jax.devices()[0]) + device_note
+    _emit(
+        {
+            "metric": "sort_ms_saved",
+            "value": round(sort_ms[False] - sort_ms[True], 3),
+            "unit": "ms/step",
+            "workload": workload,
+            "chunk": kw["chunk"],
+            "sort_ms_sorted": round(sort_ms[False], 3),
+            "sort_ms_sort_free": round(sort_ms[True], 3),
+            "wall_s_sorted": round(wall_sorted, 3),
+            "wall_s_sort_free": round(wall_free, 3),
+            "states_per_s_delta_pct": round(
+                100.0 * (rate_free - rate_sorted) / rate_sorted, 3
+            ),
+            "repeats": 5,
+            "sort_free": True,
+            "device": device,
+        }
+    )
+    _emit(
+        {
+            "value": round(rate_free, 1),
+            "vs_baseline": round(rate_free / TLC_DISTINCT_PER_S, 2),
+            "workload": workload,
+            "rate_sort_free": round(rate_free, 1),
+            "rate_sorted": round(rate_sorted, 1),
+            "generated": results[True].generated,
+            "distinct": results[True].distinct,
+            "depth": results[True].depth,
+            "wall_s": round(wall_free, 3),
+            "sort_free": True,
+            "device": device,
+        }
+    )
+    return 0
+
+
 def bench_cov_ab(probe_err: str) -> int:
     """--cov-ab: measure the cost of the device coverage plane.
 
@@ -812,6 +952,8 @@ def bench_cov_ab(probe_err: str) -> int:
 def main() -> int:
     device_note = ""
     probe_err = _probe_backend()
+    if "--commit-ab" in sys.argv:
+        return bench_commit_ab(probe_err)
     if "--cov-ab" in sys.argv:
         return bench_cov_ab(probe_err)
     if "--obs-ab" in sys.argv:

@@ -50,6 +50,9 @@ class CheckRequest:
     sharded: int = 0
     chunk: int = 1024
     pipeline: bool = False
+    # tri-state -sort-free/-no-sort-free: None = auto (the engines
+    # resolve it against the chunk, engine.bfs.resolve_sort_free)
+    sortfree: Optional[bool] = None
     routefactor: float = 2.0
     qcap: int = 1 << 15
     fpcap: int = 1 << 20
@@ -199,6 +202,7 @@ def _run_check(args) -> int:
         params=dict(chunk=args.chunk, queue_capacity=args.qcap,
                     fp_capacity=args.fpcap, sharded=args.sharded,
                     pipeline=args.pipeline,
+                    sort_free=_sort_free(args),
                     obs_slots=_obs_slots(args)),
     )
 
@@ -396,6 +400,7 @@ def _dispatch_check(args, spec, log):
                 pipeline=args.pipeline,
                 obs_slots=_obs_slots(args),
                 coverage=args.coverage,
+                sort_free=args.sortfree,
                 opts=_sup_opts(args, log),
             )
             return sup.result, sup
@@ -412,6 +417,7 @@ def _dispatch_check(args, spec, log):
                                     coverage=args.coverage),
             pipeline=args.pipeline,
             obs_slots=_obs_slots(args),
+            sort_free=args.sortfree,
         ), None
     if args.fpset == "DiskFPSet":
         # the OffHeapDiskFPSet/DiskStateQueue analog: authoritative dedup +
@@ -448,6 +454,7 @@ def _dispatch_check(args, spec, log):
             pipeline=args.pipeline,
             obs_slots=_obs_slots(args),
             coverage=args.coverage,
+            sort_free=args.sortfree,
             opts=_sup_opts(args, log),
         )
         return sup.result, sup
@@ -462,6 +469,7 @@ def _dispatch_check(args, spec, log):
         pipeline=args.pipeline,
         obs_slots=_obs_slots(args),
         coverage=args.coverage,
+        sort_free=args.sortfree,
     ), None
 
 
@@ -552,6 +560,14 @@ def _obs_slots(args) -> int:
     entirely (the A/B baseline; also the shape pre-obs checkpoints
     expect), otherwise -obs-slots levels of history ride the carry."""
     return args.obsslots if args.obs else 0
+
+
+def _sort_free(args) -> bool:
+    """The RESOLVED -sort-free mode this run's engines will use (the
+    run_start journal manifest records the fact, not the tri-state)."""
+    from .engine.bfs import resolve_sort_free
+
+    return resolve_sort_free(getattr(args, "sortfree", None), args.chunk)
 
 
 def _open_journal(args, workload: str, engine: str, device: str,
@@ -654,6 +670,10 @@ def _resume_command(args) -> str:
         parts += ["-sharded", str(args.sharded)]
     if args.pipeline:
         parts += ["-pipeline"]  # checkpoints only resume in the same mode
+    if getattr(args, "sortfree", None) is not None:
+        # auto re-resolves identically from the chunk; only an explicit
+        # override must travel so the meta mode check stays satisfied
+        parts += ["-sort-free" if args.sortfree else "-no-sort-free"]
     if getattr(args, "narrow", False):
         parts += ["-narrow"]  # the narrowed codec is a different layout
     if getattr(args, "coverage", False):
@@ -756,6 +776,7 @@ def _run_check_gen(args, spec) -> int:
             backend=backend,
             pipeline=args.pipeline,
             obs_slots=_obs_slots(args),
+            sort_free=args.sortfree,
         )
         if args.checkpoint:
             meta_config = {
@@ -893,6 +914,7 @@ def _run_check_struct(args, spec) -> int:
                     route_factor=args.routefactor,
                     pipeline=args.pipeline,
                     obs_slots=_obs_slots(args),
+                    sort_free=args.sortfree,
                     opts=_sup_opts(args, log), **kw,
                 )
                 return sup.result, sup
@@ -900,7 +922,7 @@ def _run_check_struct(args, spec) -> int:
                 sm, mesh, route_factor=args.routefactor,
                 check_deadlock=ckd, pipeline=args.pipeline,
                 obs_slots=_obs_slots(args), bounds=bounds,
-                coverage=cov, **kw,
+                coverage=cov, sort_free=args.sortfree, **kw,
             ), None
         if args.checkpoint or args.autogrow:
             from .resil import check_supervised
@@ -913,13 +935,14 @@ def _run_check_struct(args, spec) -> int:
                 check_deadlock=ckd,
                 pipeline=args.pipeline,
                 obs_slots=_obs_slots(args),
+                sort_free=args.sortfree,
                 opts=_sup_opts(args, log), **kw,
             )
             return sup.result, sup
         return check_struct(
             sm, fp_index=spec.fp_index, check_deadlock=ckd,
             pipeline=args.pipeline, obs_slots=_obs_slots(args),
-            bounds=bounds, coverage=cov, **kw,
+            bounds=bounds, coverage=cov, sort_free=args.sortfree, **kw,
         ), None
 
     def props():
@@ -1131,6 +1154,7 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
         params=dict(chunk=args.chunk, queue_capacity=args.qcap,
                     fp_capacity=args.fpcap, sharded=args.sharded,
                     pipeline=args.pipeline, frontend=kit.kind,
+                    sort_free=_sort_free(args),
                     obs_slots=_obs_slots(args)),
     )
     if kit.preflight is not None:

@@ -89,6 +89,25 @@ def main(argv=None) -> int:
                         "must use the same mode")
     c.add_argument("-no-pipeline", dest="pipeline", action="store_false",
                    help="(default) the fused single-stage step bodies")
+    c.add_argument("-sort-free", dest="sortfree", action="store_const",
+                   const=True, default=None,
+                   help="commit through the hash-slab dedup instead of "
+                        "the two full-width stable sorts (ISSUE 12): "
+                        "scatter-max in-batch dedup + a probe-width "
+                        "claimant compaction, inherited by every engine "
+                        "at the expand/commit seam (fused, -pipeline, "
+                        "-sharded, spill, -phase-timing, -narrow, "
+                        "-coverage).  Results are bit-for-bit the "
+                        "sorted path's - full signature AND fpset "
+                        "table words (bench.py --commit-ab gates it).  "
+                        "Default auto: on at -chunk >= 2048, where the "
+                        "fitted cost model shows the sorts at 89%% of "
+                        "commit (COSTMODEL.json); off below, where "
+                        "they are cheap.  A checkpoint records the "
+                        "resolved mode: -recover must match")
+    c.add_argument("-no-sort-free", dest="sortfree", action="store_const",
+                   const=False,
+                   help="force the sorted dedup commit at any chunk")
     c.add_argument("-routefactor", type=float, default=2.0,
                    help="sharded all_to_all bucket size as a multiple of "
                         "the mean per-owner candidate count (raise after "

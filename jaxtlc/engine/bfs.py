@@ -51,7 +51,7 @@ from jax import lax
 from ..config import ModelConfig
 from ..spec.labels import LABELS
 from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED, fp64_words_mxu
-from .fpset import fpset_insert_sorted, fpset_new
+from .fpset import fpset_insert_dedup, fpset_insert_sorted, fpset_new
 
 # violation codes
 OK = 0
@@ -197,6 +197,24 @@ def carry_done(carry: EngineCarry) -> bool:
 
 DEFAULT_FP_HIGHWATER = 0.85
 
+# -sort-free auto threshold: the fitted cost model (COSTMODEL.json,
+# PERF.md round 11) shows the two full-width dedup sorts dominating
+# commit at large chunks (8.3 of 9.3 ms at chunk 2048 = 89%); at small
+# chunks the sorts are cheap and the slab setup is pure overhead, so
+# auto keeps the sorted path there.
+SORT_FREE_AUTO_CHUNK = 2048
+
+
+def resolve_sort_free(sort_free, chunk: int) -> bool:
+    """Resolve the tri-state -sort-free flag (None = auto) for an
+    engine popping `chunk` states per step.  Deterministic in the
+    geometry alone, so every layer that needs the resolved mode -
+    engine factories, struct engine memos, checkpoint meta, the resume
+    path - computes the same answer without coordination."""
+    if sort_free is not None:
+        return bool(sort_free)
+    return chunk >= SORT_FREE_AUTO_CHUNK
+
 
 def make_engine(
     cfg: ModelConfig,
@@ -210,6 +228,7 @@ def make_engine(
     donate: bool = True,
     obs_slots: int = 0,
     coverage: bool = False,
+    sort_free: bool = None,
 ):
     """Build (init_fn, run_fn, step_fn) for one KubeAPI configuration.
 
@@ -225,6 +244,7 @@ def make_engine(
         kubeapi_backend(cfg, coverage=coverage), chunk, queue_capacity,
         fp_capacity, fp_index, seed, fp_highwater=fp_highwater,
         pipeline=pipeline, donate=donate, obs_slots=obs_slots,
+        sort_free=sort_free,
     )
 
 
@@ -240,6 +260,7 @@ def make_stage_pair(
     seed: int = DEFAULT_SEED,
     obs_slots: int = 0,
     spill: bool = False,
+    sort_free: bool = False,
 ):
     """(pop_expand, commit) at pop width `ck` - the two halves of one
     BFS step, shared by every composition: the unpipelined body runs
@@ -247,6 +268,15 @@ def make_stage_pair(
     block's staged ExpandOut while pop_expand works on the next block,
     and the host spill driver (engine.spill) interleaves a host-tier
     membership check between them.
+
+    sort_free=True (a RESOLVED bool here; factories resolve the
+    tri-state flag via resolve_sort_free) commits through the hash-slab
+    dedup (fpset.fpset_insert_slab) instead of the two full-width
+    stable sorts - bit-identical results by contract, so every engine
+    composed from this seam (fused, pipelined, spill, phased, narrowed,
+    covered) inherits the mode with no per-engine code.  The slab is an
+    ephemeral per-commit tensor derived from this pair's geometry, so
+    regrow/chunk-shrink rebuilds migrate it by construction.
 
     spill=True builds the commit for spill mode: it takes an extra
     `veto` mask ([ck * n_lanes] bool, candidates the HOST fingerprint
@@ -318,9 +348,9 @@ def make_stage_pair(
                 fp_capacity * fp_highwater
             )
             insert_mask = ex.valid & ~fp_full
-        fps, is_new_c, c_idx, nreps = fpset_insert_sorted(
+        fps, is_new_c, c_idx, nreps = fpset_insert_dedup(
             c.fps, ex.lo, ex.hi, insert_mask,
-            probe_width=R, claim_width=CW,
+            probe_width=R, claim_width=CW, sort_free=sort_free,
         )
         n_new = is_new_c.sum().astype(jnp.int32)
         q_full = c.next_n + n_new > qcap
@@ -535,6 +565,7 @@ def make_backend_engine(
     pipeline: bool = False,
     donate: bool = True,
     obs_slots: int = 0,
+    sort_free: bool = None,
 ):
     """Build (init_fn, run_fn, step_fn) over any SpecBackend.
 
@@ -584,11 +615,20 @@ def make_backend_engine(
     control flow and no arbitration - so check results with obs on are
     bit-for-bit those of an obs-off run (bench.py --obs-ab gates the
     wall-clock overhead at <= 2%).
+
+    sort_free (tri-state: None = auto, resolve_sort_free) selects the
+    hash-slab commit dedup in place of the two full-width stable sorts
+    (ISSUE 12).  Results are BIT-FOR-BIT the sorted path's - full
+    signature plus fpset TABLE words (bench.py --commit-ab gates it) -
+    the flag is purely a performance mode, but it is still recorded in
+    engine memos and checkpoint meta so a resume can never silently
+    cross modes.
     """
     from ..obs.counters import ring_new
     from .backend import ExpandOut
 
     assert 0.0 < fp_highwater <= 1.0, "fp_highwater must be in (0, 1]"
+    sort_free = resolve_sort_free(sort_free, chunk)
     has_cert = backend.cert_check is not None
     cov_plane = backend.coverage
     n_sites = cov_plane.n_sites if cov_plane is not None else 0
@@ -711,6 +751,7 @@ def make_backend_engine(
             backend, ck, queue_capacity=qcap, fp_capacity=fp_capacity,
             fp_highwater=fp_highwater, check_deadlock=check_deadlock,
             fp_index=fp_index, seed=seed, obs_slots=obs_slots,
+            sort_free=sort_free,
         )
 
     def make_body(ck: int):
@@ -835,6 +876,7 @@ def check(
     pipeline: bool = False,
     obs_slots: int = 0,
     coverage: bool = False,
+    sort_free: bool = None,
 ) -> CheckResult:
     """Run an exhaustive check; the single-device engine entry point.
 
@@ -848,6 +890,7 @@ def check(
     init_fn, run_fn, _ = make_backend_engine(
         backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater=fp_highwater, pipeline=pipeline, obs_slots=obs_slots,
+        sort_free=sort_free,
     )
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()

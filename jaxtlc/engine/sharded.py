@@ -176,6 +176,7 @@ def make_sharded_engine(
     fp_highwater: float = None,
     pipeline: bool = False,
     obs_slots: int = 0,
+    sort_free: bool = None,
 ):
     """Build (init_fn, run_fn) over `mesh` (single axis named "fp").
 
@@ -211,6 +212,14 @@ def make_sharded_engine(
     per-level act_dist attributes to the correct level (the PR 5
     documented lag, since fixed; the deferred-row leaves on ShardCarry
     carry the staged flip across the body boundary).
+
+    sort_free (tri-state, resolved against the PER-DEVICE chunk by
+    bfs.resolve_sort_free) takes the hash-slab dedup on the owner-side
+    insert - the all_to_all routing argsort is untouched (it orders by
+    OWNER, not fingerprint; a different problem than dedup).  The
+    owner-side received batch is D*B wide but carries ~2 valid
+    candidates per popped state, so the slab compaction runs at ~4x
+    chunk rows; results are bit-for-bit the sorted engine's.
     """
     from ..obs.counters import (
         pack_row,
@@ -241,6 +250,13 @@ def make_sharded_engine(
     # per-destination bucket size: O(ncand/D) so send-buffer bytes stay
     # constant as the mesh grows (VERDICT round 2, weak #5)
     B = route_bucket_width(chunk, L, D, route_factor)
+    from .bfs import resolve_sort_free
+
+    sort_free = resolve_sort_free(sort_free, chunk)
+    # slab compaction width of the owner-side insert: received valid
+    # candidates ~2 per popped state at steady load balance, so 4x
+    # chunk covers bursts; wider batches take the exact sorted fallback
+    SRW = min(4 * chunk, D * B)
 
     def owner_of(hi):
         return (hi & jnp.uint32(D - 1)).astype(jnp.int32)
@@ -445,7 +461,9 @@ def make_sharded_engine(
             fp_capacity * fp_highwater
         )
         ins_mask = r_valid & ~fp_full
-        fset, is_new = fpset_insert(FPSet(table), r_lo, r_hi, ins_mask)
+        fset, is_new = fpset_insert(FPSet(table), r_lo, r_hi, ins_mask,
+                                    sort_free=sort_free,
+                                    probe_width=SRW)
 
         n_new = is_new.sum().astype(jnp.int32)
         q_full = (qtail - qhead) + n_new > qcap
@@ -898,6 +916,7 @@ def check_sharded(
     backend: SpecBackend = None,
     pipeline: bool = False,
     obs_slots: int = 0,
+    sort_free: bool = None,
 ) -> CheckResult:
     """Exhaustive sharded check; returns globally-reduced statistics.
 
@@ -908,7 +927,7 @@ def check_sharded(
     init_fn, run_fn = make_sharded_engine(
         cfg, mesh, chunk, queue_capacity, fp_capacity,
         route_factor=route_factor, backend=backend, pipeline=pipeline,
-        obs_slots=obs_slots,
+        obs_slots=obs_slots, sort_free=sort_free,
     )
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()
@@ -937,6 +956,7 @@ def check_sharded_with_checkpoints(
     meta_config: dict = None,
     pipeline: bool = False,
     obs_slots: int = 0,
+    sort_free: bool = None,
 ) -> CheckResult:
     """Sharded check with periodic whole-carry checkpoints (TLC checkpoint
     analog under distribution: one snapshot covers every shard's partition
@@ -944,14 +964,16 @@ def check_sharded_with_checkpoints(
     checkpoint.check_with_checkpoints, over the mesh engine."""
     import os
 
+    from .bfs import resolve_sort_free
     from .checkpoint import _meta, load_checkpoint, save_checkpoint
 
     if backend is None:
         backend = kubeapi_backend(cfg)
+    sort_free = resolve_sort_free(sort_free, chunk)
     init_fn, seg_fn = make_sharded_engine(
         cfg, mesh, chunk, queue_capacity, fp_capacity,
         route_factor=route_factor, segment=ckpt_every, backend=backend,
-        pipeline=pipeline, obs_slots=obs_slots,
+        pipeline=pipeline, obs_slots=obs_slots, sort_free=sort_free,
     )
     meta = _meta(
         cfg,
@@ -961,6 +983,7 @@ def check_sharded_with_checkpoints(
         devices=int(mesh.devices.size),
         pipeline=pipeline,
         obs_slots=obs_slots,
+        sort_free=sort_free,
     )
     template = init_fn()
     compiled = seg_fn.lower(template).compile()
@@ -970,11 +993,12 @@ def check_sharded_with_checkpoints(
             raise FileNotFoundError(f"no checkpoint at {ckpt_path!r}")
         saved_meta, carry = load_checkpoint(ckpt_path, template)
         for key in ("format", "config", "queue_capacity", "fp_capacity",
-                    "devices", "pipeline", "obs_slots"):
-            # pre-pipeline/pre-obs snapshots carry no key: treat as
-            # off - they were cut from engines without those leaves
+                    "devices", "pipeline", "obs_slots", "sort_free"):
+            # pre-pipeline/pre-obs/pre-sort-free snapshots carry no
+            # key: treat as off - they were cut from engines without
+            # those features
             saved = saved_meta.get(
-                key, False if key == "pipeline"
+                key, False if key in ("pipeline", "sort_free")
                 else 0 if key == "obs_slots" else None
             )
             if saved != meta[key]:

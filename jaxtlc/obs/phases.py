@@ -121,7 +121,7 @@ class PhasedRuntime:
     def __init__(self, backend, chunk: int, queue_capacity: int,
                  fp_capacity: int, fp_index: int = None, seed: int = None,
                  fp_highwater: float = None, check_deadlock: bool = None,
-                 obs_slots: int = 0,
+                 obs_slots: int = 0, sort_free: bool = None,
                  recorder: Optional[PhaseRecorder] = None):
         import jax
 
@@ -129,6 +129,7 @@ class PhasedRuntime:
             DEFAULT_FP_HIGHWATER,
             make_backend_engine,
             make_stage_pair,
+            resolve_sort_free,
         )
         from ..engine.fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
 
@@ -136,13 +137,14 @@ class PhasedRuntime:
         seed = DEFAULT_SEED if seed is None else seed
         fp_highwater = (DEFAULT_FP_HIGHWATER if fp_highwater is None
                         else fp_highwater)
+        sort_free = resolve_sort_free(sort_free, chunk)
         self.recorder = recorder if recorder is not None else PhaseRecorder()
         self.chunk = chunk
         # init template through the production factory (jits are lazy)
         init_fn, _, _ = make_backend_engine(
             backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
             fp_highwater=fp_highwater, check_deadlock=check_deadlock,
-            donate=False, obs_slots=obs_slots,
+            donate=False, obs_slots=obs_slots, sort_free=sort_free,
         )
         self._base_init = init_fn
 
@@ -151,7 +153,7 @@ class PhasedRuntime:
                 backend, ck, queue_capacity=queue_capacity,
                 fp_capacity=fp_capacity, fp_highwater=fp_highwater,
                 check_deadlock=check_deadlock, fp_index=fp_index,
-                seed=seed, obs_slots=obs_slots,
+                seed=seed, obs_slots=obs_slots, sort_free=sort_free,
             )
             expand_fn = jax.jit(lambda c: pop_expand(c))
             commit_fn = jax.jit(
@@ -237,7 +239,8 @@ def _fused_time(body, carry, K: int = 4, reps: int = 3) -> float:
 def subphase_walls(backend, chunk: int, queue_capacity: int,
                    fp_capacity: int, warm_steps: int = 8,
                    K: int = 4, reps: int = 3,
-                   check_deadlock: bool = None) -> Dict[str, float]:
+                   check_deadlock: bool = None,
+                   sort_free: bool = False) -> Dict[str, float]:
     """Differential sub-phase attribution on a warmed mid-run carry.
 
     Drives the real engine `warm_steps` steps (realistic frontier block
@@ -247,7 +250,11 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
         kernel        pop + unpack + vmap(step)           (measured)
         inv_fp        expand - kernel: invariant eval + MXU fingerprints
         expand        the full expand stage                 (measured)
-        sort          the two dedup sorts of fpset_insert_sorted
+        sort          the in-batch dedup stage: the two full-width
+                      stable sorts of fpset_insert_sorted, or (under
+                      sort_free=True) the hash-slab dedup that
+                      replaces them (fpset.slab_dedup) - same column,
+                      so before/after cost models line up
         probe         insert - sort: the fpset probe/claim walk
         enqueue       step - expand - insert: enqueue + stats + fencing
         commit        step - expand
@@ -263,7 +270,7 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
     from ..engine.backend import make_expand_stage
     from ..engine.bfs import make_backend_engine
     from ..engine.fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
-    from ..engine.fpset import fpset_insert_sorted
+    from ..engine.fpset import fpset_insert_dedup, slab_dedup
 
     cdc = backend.cdc
     W = (cdc.nbits + 31) // 32
@@ -274,6 +281,7 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
     init_fn, _, step_fn = make_backend_engine(
         backend, chunk, queue_capacity, fp_capacity,
         check_deadlock=check_deadlock, donate=False,
+        sort_free=sort_free,
     )
     carry = init_fn()
     for _ in range(warm_steps):
@@ -307,33 +315,41 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
 
     t_expand = _fused_time(b_expand, jnp.zeros(W, jnp.uint32), K, reps)
 
-    # sort: the two dedup sorts of fpset_insert_sorted (group + compact)
+    # sort: the in-batch dedup stage - the two full-width stable sorts,
+    # or the hash-slab dedup that replaces them under -sort-free
     idx = jnp.arange(ncand, dtype=jnp.uint32)
 
-    def b_sort(x):
-        s_hi, s_lo, s_idx = lax.sort(
-            (ex.hi, ex.lo ^ x, idx), num_keys=2, is_stable=True
-        )
-        last = jnp.concatenate(
-            [(s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1]),
-             jnp.ones(1, bool)]
-        )
-        rep = ((s_hi != 0) | (s_lo != 0)) & last
-        _, c_lo, c_hi, c_idx = lax.sort(
-            ((~rep).astype(jnp.uint32), s_lo, s_hi, s_idx),
-            num_keys=1, is_stable=True,
-        )
-        return x + c_lo[0]
+    if sort_free:
+        def b_sort(x):
+            c_lo, _c_hi, _c_ix, _nreps, _fb = slab_dedup(
+                ex.lo ^ x, ex.hi, ex.valid, probe_width=R,
+            )
+            return x + c_lo[0]
+    else:
+        def b_sort(x):
+            s_hi, s_lo, s_idx = lax.sort(
+                (ex.hi, ex.lo ^ x, idx), num_keys=2, is_stable=True
+            )
+            last = jnp.concatenate(
+                [(s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1]),
+                 jnp.ones(1, bool)]
+            )
+            rep = ((s_hi != 0) | (s_lo != 0)) & last
+            _, c_lo, c_hi, c_idx = lax.sort(
+                ((~rep).astype(jnp.uint32), s_lo, s_hi, s_idx),
+                num_keys=1, is_stable=True,
+            )
+            return x + c_lo[0]
 
     t_sort = _fused_time(b_sort, jnp.uint32(1), K, reps)
 
-    # insert: sorts + probe/claim at real table load (vary lo so the
+    # insert: dedup + probe/claim at real table load (vary lo so the
     # probes are honest; occupancy growth over K reps is negligible)
     def b_ins(c):
         fps_c, x = c
-        f2, _, _, _ = fpset_insert_sorted(
+        f2, _, _, _ = fpset_insert_dedup(
             fps_c, ex.lo ^ x, ex.hi, ex.valid,
-            probe_width=R, claim_width=R,
+            probe_width=R, claim_width=R, sort_free=sort_free,
         )
         return (f2, x + jnp.uint32(1))
 

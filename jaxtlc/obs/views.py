@@ -274,6 +274,10 @@ _BENCH_BASE = {
     "unit": "states/s",
     "vs_baseline": 0,
     "pipeline": False,
+    # which commit dedup produced the number (ISSUE 12): the sorted
+    # path (False) or the hash-slab sort-free path (True); modes that
+    # run both put their setting in explicitly, like "pipeline"
+    "sort_free": False,
 }
 
 

@@ -102,7 +102,8 @@ class _SingleRunner:
     compile once at build, fresh carry + warm executable per job."""
 
     def __init__(self, model, chunk, queue_capacity, fp_capacity,
-                 fp_index, seed, check_deadlock, pipeline, obs_slots):
+                 fp_index, seed, check_deadlock, pipeline, obs_slots,
+                 sort_free=None):
         from ..engine.bfs import DEFAULT_FP_HIGHWATER
         from ..struct.cache import get_backend, get_engine
 
@@ -112,7 +113,7 @@ class _SingleRunner:
         init_fn, run_fn, _ = get_engine(
             model, chunk, queue_capacity, fp_capacity, fp_index, seed,
             DEFAULT_FP_HIGHWATER, check_deadlock=check_deadlock,
-            pipeline=pipeline, obs_slots=obs_slots,
+            pipeline=pipeline, obs_slots=obs_slots, sort_free=sort_free,
         )
         import jax
 
@@ -201,6 +202,7 @@ class EnginePool:
         check_deadlock: bool = True,
         pipeline: bool = False,
         obs_slots: int = 0,
+        sort_free: bool = None,
     ) -> PoolEntry:
         """Warm plain engine for (model meaning, geometry) - keyed on
         the struct-cache memo key, so pool identity == memo identity."""
@@ -210,13 +212,14 @@ class EnginePool:
         key = engine_key(
             model, chunk, queue_capacity, fp_capacity, fp_index, seed,
             DEFAULT_FP_HIGHWATER, check_deadlock=check_deadlock,
-            pipeline=pipeline, obs_slots=obs_slots,
+            pipeline=pipeline, obs_slots=obs_slots, sort_free=sort_free,
         )
         return self._get_or_build(
             key,
             lambda: _SingleRunner(
                 model, chunk, queue_capacity, fp_capacity, fp_index,
                 seed, check_deadlock, pipeline, obs_slots,
+                sort_free=sort_free,
             ),
             "single",
             dict(workload=model.root_name, chunk=chunk,
@@ -233,14 +236,16 @@ class EnginePool:
         fp_index: int = DEFAULT_FP_INDEX,
         seed: int = DEFAULT_SEED,
         check_deadlock: bool = True,
+        sort_free: bool = None,
     ) -> PoolEntry:
         """Warm constants-class sweep engine: one entry per CLASS (the
         swept values are runtime data, not key material)."""
+        from ..engine.bfs import resolve_sort_free
         from .sweep import SweepEngine, class_key
 
         key = ("sweep", class_key(model, params), chunk, queue_capacity,
                fp_capacity, fp_index, seed, bool(check_deadlock),
-               int(self.sweep_width))
+               int(self.sweep_width), resolve_sort_free(sort_free, chunk))
         return self._get_or_build(
             key,
             lambda: SweepEngine(
@@ -248,6 +253,7 @@ class EnginePool:
                 queue_capacity=queue_capacity, fp_capacity=fp_capacity,
                 fp_index=fp_index, seed=seed,
                 check_deadlock=check_deadlock, width=self.sweep_width,
+                sort_free=sort_free,
             ),
             "sweep",
             dict(workload=model.root_name, chunk=chunk,

@@ -43,9 +43,9 @@ DEFAULT_LARGE_FPCAP = 1 << 16  # above this, a job is "large"
 # job options forwarded to api.CheckRequest on the supervised path
 _REQUEST_OPTIONS = (
     "workers", "frontend", "chunk", "qcap", "fpcap", "pipeline",
-    "sharded", "checkpoint", "recover", "liveness", "fairness",
-    "nodeadlock", "faults", "retry", "maxregrow", "spill", "obs",
-    "obsslots", "coverage",
+    "sortfree", "sharded", "checkpoint", "recover", "liveness",
+    "fairness", "nodeadlock", "faults", "retry", "maxregrow", "spill",
+    "obs", "obsslots", "coverage",
 )
 _HEAVY_OPTIONS = ("checkpoint", "recover", "sharded", "liveness",
                   "faults", "coverage")
@@ -303,6 +303,7 @@ class Scheduler:
             queue_capacity=int(o.get("qcap", 1 << 10)),
             fp_capacity=int(o.get("fpcap", 1 << 12)),
             check_deadlock=not o.get("nodeadlock", False),
+            sort_free=o.get("sortfree", None),
         )
 
     def _run_sweep(self, batch: List[Job]) -> None:

@@ -217,19 +217,25 @@ def engine_key(
     obs_slots: int = 0,
     bounds=None,
     coverage: bool = False,
+    sort_free: bool = None,
 ) -> tuple:
     """The full engine-memo key: spec meaning (digest + canonical
-    constants + invariants) x engine geometry x pipeline/obs/coverage
-    flags x the certified-bound digest (a narrowed engine is a
-    DIFFERENT compile - its codec, lanes and traps all change with the
-    bounds; a covered engine carries the coverage leaves).  The serve
+    constants + invariants) x engine geometry x pipeline/obs/coverage/
+    sort-free flags x the certified-bound digest (a narrowed engine is
+    a DIFFERENT compile - its codec, lanes and traps all change with
+    the bounds; a covered engine carries the coverage leaves; a
+    sort-free engine compiles the hash-slab commit).  The serve
     EnginePool keys its warm AOT entries on exactly this tuple so pool
-    identity and memo identity cannot drift."""
+    identity and memo identity cannot drift.  `sort_free` is resolved
+    (tri-state auto -> bool) against the chunk so the key never
+    depends on who asked."""
+    from ..engine.bfs import resolve_sort_free
+
     return (
         model_key(model), "single", chunk, queue_capacity, fp_capacity,
         fp_index, seed, fp_highwater, bool(check_deadlock),
         bool(pipeline), int(obs_slots), _bounds_key(bounds),
-        bool(coverage),
+        bool(coverage), resolve_sort_free(sort_free, chunk),
     )
 
 
@@ -246,6 +252,7 @@ def get_engine(
     obs_slots: int = 0,
     bounds=None,
     coverage: bool = False,
+    sort_free: bool = None,
 ) -> Tuple:
     """Memoized single-device engine triple (init_fn, run_fn, step_fn)
     for a struct model; enables the persistent XLA cache as a side
@@ -254,7 +261,9 @@ def get_engine(
     engine is a different compile than an obs-off one.  `bounds`
     selects the narrowed engine (certificate check on, keyed on the
     bound digest); `coverage` the covered engine (per-site counter
-    leaves on the carry)."""
+    leaves on the carry); `sort_free` the hash-slab commit (resolved
+    against the chunk, so an auto caller and an explicit caller at the
+    same geometry share one memo entry)."""
     from ..engine.bfs import make_backend_engine
 
     enable_persistent_cache()
@@ -262,6 +271,7 @@ def get_engine(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
         obs_slots=obs_slots, bounds=bounds, coverage=coverage,
+        sort_free=sort_free,
     )
     hit = _ENGINE_MEMO.get(key)
     if hit is None:
@@ -270,7 +280,7 @@ def get_engine(
         hit = make_backend_engine(
             backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
             fp_highwater=fp_highwater, pipeline=pipeline,
-            obs_slots=obs_slots,
+            obs_slots=obs_slots, sort_free=sort_free,
         )
         _ENGINE_MEMO.put(key, hit)
     return hit

@@ -55,16 +55,19 @@ def check_struct(
     obs_slots: int = 0,
     bounds=None,
     coverage: bool = False,
+    sort_free: bool = None,
 ) -> CheckResult:
     """Exhaustive device check of a struct-compiled spec (single device,
     fused loop; AOT-compiled before timing like bfs.check).  `bounds`
     (a certified analysis.absint.BoundReport) runs the NARROWED engine
     with the runtime certificate check on; `coverage` the covered
-    engine (device per-site coverage on CheckResult.site_coverage)."""
+    engine (device per-site coverage on CheckResult.site_coverage);
+    `sort_free` the hash-slab commit (bit-identical results)."""
     init_fn, run_fn, _ = get_engine(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
         obs_slots=obs_slots, bounds=bounds, coverage=coverage,
+        sort_free=sort_free,
     )
     backend = get_backend(model, check_deadlock, bounds=bounds,
                           coverage=coverage)
@@ -92,6 +95,7 @@ def check_struct_sharded(
     obs_slots: int = 0,
     bounds=None,
     coverage: bool = False,
+    sort_free: bool = None,
 ) -> CheckResult:
     """Exhaustive mesh-sharded check of a struct-compiled spec
     (capacities PER DEVICE; fingerprint-space all_to_all partitioning,
@@ -108,4 +112,5 @@ def check_struct_sharded(
         None, mesh, chunk=chunk, queue_capacity=queue_capacity,
         fp_capacity=fp_capacity, route_factor=route_factor,
         backend=backend, pipeline=pipeline, obs_slots=obs_slots,
+        sort_free=sort_free,
     )

@@ -542,7 +542,7 @@ def test_selfcheck_registry_pinned():
 
     assert sorted(FACTORIES) == [
         "covered", "enumerator", "fused", "narrowed", "phased",
-        "pipelined", "sharded", "spill", "struct", "sweep",
+        "pipelined", "sharded", "sortfree", "spill", "struct", "sweep",
     ]
 
 

@@ -116,8 +116,23 @@ def test_committed_costmodel_document():
     assert parts > 0
     assert doc["ms_per_step"]["commit"][big] > 0
     assert doc["phase_event_ms_per_step"]["commit"][big] > 0
+    # v2 (ISSUE 12): the sort-free columns ride the same document, and
+    # the committed numbers must carry the acceptance relation - the
+    # hash-slab dedup at the largest chunk is >= 2x cheaper than the
+    # two full-width sorts it replaces (deterministic: this checks the
+    # COMMITTED measurement, not the machine running the test)
+    assert doc["ms_per_step_sort_free"]["sort"][big] <= (
+        doc["ms_per_step"]["sort"][big] / 2.0
+    )
+    for p in mod.PHASES:
+        assert "a_ms" in doc["fit_sort_free"][p], p
+        # v2 clamps: no fitted slope may be negative (the r11 enqueue
+        # column's -1.32 is the regression this guards)
+        assert doc["fit"][p]["b_ms_per_1k"] >= 0, p
+        assert doc["fit_sort_free"][p]["b_ms_per_1k"] >= 0, p
     # and the table renderer accepts the committed document
     assert "| chunk |" in mod.perf_table(doc)
+    assert "sort-free commit" in mod.perf_table(doc)
 
 
 def test_loadgen_tiny_smoke(capsys):
@@ -172,6 +187,9 @@ def test_bench_emit_enforces_payload_contract(capsys):
         for field in REQUIRED_PAYLOAD_FIELDS:
             assert field in payload, f"payload lost {field!r}: {payload}"
         assert "pipeline" in payload
+        # ISSUE 12: which commit dedup produced the number rides every
+        # payload, exactly like the pipeline flag
+        assert "sort_free" in payload
     # both emissions were journaled as validated bench_metric events
     kinds = [e["event"] for e in bench._JOURNAL.events]
     assert kinds.count("bench_metric") == 2

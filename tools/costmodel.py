@@ -34,7 +34,13 @@ sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
 )
 
-COSTMODEL_VERSION = 1
+# v2 (ISSUE 12): per-phase fits clamped to nonnegative slopes (the r11
+# document carried a nonphysical enqueue slope of -1.32 ms/1k chunk -
+# amortized-to-zero measurements fitted through noise), plus the
+# sort-free commit columns (ms_per_step_sort_free / fit_sort_free: the
+# same sweep measured with the hash-slab dedup) so the before/after of
+# the ROADMAP #1 commit rewrite lives in one committed document.
+COSTMODEL_VERSION = 2
 
 # the phase columns of the emitted table, in pipeline order
 PHASES = ("kernel", "inv_fp", "expand", "sort", "probe", "enqueue",
@@ -101,7 +107,13 @@ def _pipelined_step_ms(backend, chunk: int, qcap: int, fpcap: int,
 def fit_linear(chunks, ms_values) -> dict:
     """Least-squares ms(chunk) = a + b*chunk; b reported per 1k chunk
     (the PERF r4 convention).  Degenerate sweeps (one point) pin the
-    intercept to the measurement."""
+    intercept to the measurement.
+
+    Slopes are CLAMPED to nonnegative: a wall time cannot shrink as
+    the chunk grows, so a negative fitted slope is measurement noise
+    through an amortized-to-zero phase (the r11 document's enqueue
+    column fitted b = -1.32 ms/1k).  A clamped fit refits at b = 0
+    (a = mean) and records `clamped: true`; the table marks it."""
     import numpy as np
 
     x = np.asarray(chunks, float)
@@ -110,19 +122,26 @@ def fit_linear(chunks, ms_values) -> dict:
         return {"a_ms": round(float(y[0]), 4), "b_ms_per_1k": 0.0,
                 "r2": 1.0}
     b, a = np.polyfit(x, y, 1)
+    clamped = b < 0
+    if clamped:
+        b, a = 0.0, float(y.mean())
     pred = a + b * x
     ss_res = float(((y - pred) ** 2).sum())
     ss_tot = float(((y - y.mean()) ** 2).sum())
     r2 = 1.0 - ss_res / ss_tot if ss_tot > 0 else 1.0
-    return {"a_ms": round(float(a), 4),
-            "b_ms_per_1k": round(float(b) * 1024, 4),
-            "r2": round(r2, 4)}
+    out = {"a_ms": round(float(a), 4),
+           "b_ms_per_1k": round(float(b) * 1024, 4),
+           "r2": round(r2, 4)}
+    if clamped:
+        out["clamped"] = True
+    return out
 
 
 def real_measure(backend, qcap: int, fpcap: int, warm: int, K: int,
                  reps: int, phased_steps: int):
     """measure(chunk) over the real engines: differential sub-phase
-    walls + phase-event walls + the pipelined step."""
+    walls (sorted AND sort-free commit) + phase-event walls + the
+    pipelined step."""
     from jaxtlc.obs.phases import subphase_walls
 
     def measure(chunk):
@@ -130,11 +149,15 @@ def real_measure(backend, qcap: int, fpcap: int, warm: int, K: int,
             backend, chunk, qcap, fpcap, warm_steps=warm, K=K,
             reps=reps,
         )
+        walls_sf = subphase_walls(
+            backend, chunk, qcap, fpcap, warm_steps=warm, K=K,
+            reps=reps, sort_free=True,
+        )
         ev = _phase_event_walls(backend, chunk, qcap, fpcap,
                                 phased_steps)
         pipe = _pipelined_step_ms(backend, chunk, qcap, fpcap, warm,
                                   K, reps)
-        return walls, ev, pipe
+        return walls, ev, pipe, walls_sf
 
     return measure
 
@@ -149,12 +172,22 @@ _SYNTH = {"kernel": (0.5, 0.004), "inv_fp": (0.1, 0.001),
           "probe": (0.1, 0.0015), "enqueue": (0.15, 0.0005),
           "commit": (0.3, 0.004), "step": (0.9, 0.009)}
 
+# the synthetic sort-free walls: the dedup ("sort") column shrinks 4x,
+# commit/step shrink by the saving - also exactly linear, so the tiny
+# smoke asserts the v2 sort-free fit recovers planted coefficients too
+_SYNTH_SF = dict(_SYNTH)
+_SYNTH_SF.update({"sort": (0.0125, 0.0005),
+                  "commit": (0.2625, 0.0025),
+                  "step": (0.8625, 0.0075)})
+
 
 def synthetic_measure(chunk):
     walls = {p: (a + b * chunk) / 1e3 for p, (a, b) in _SYNTH.items()}
+    walls_sf = {p: (a + b * chunk) / 1e3
+                for p, (a, b) in _SYNTH_SF.items()}
     ev = {"expand_ms": 1e3 * walls["expand"],
           "commit_ms": 1e3 * walls["commit"], "bodies": 8}
-    return walls, ev, 1e3 * walls["step"] * 0.9
+    return walls, ev, 1e3 * walls["step"] * 0.9, walls_sf
 
 
 def sweep(workload: str, chunks, geometry: dict, measure) -> dict:
@@ -164,13 +197,15 @@ def sweep(workload: str, chunks, geometry: dict, measure) -> dict:
     import jax
 
     ms = {p: {} for p in PHASES}
+    ms_sf = {p: {} for p in PHASES}
     events_ms = {"expand": {}, "commit": {}}
     pipe_ms = {}
     for chunk in chunks:
         t0 = time.time()
-        walls, ev, pipe = measure(chunk)
+        walls, ev, pipe, walls_sf = measure(chunk)
         for p in PHASES:
             ms[p][str(chunk)] = round(1e3 * walls[p], 4)
+            ms_sf[p][str(chunk)] = round(1e3 * walls_sf[p], 4)
         events_ms["expand"][str(chunk)] = round(ev["expand_ms"], 4)
         events_ms["commit"][str(chunk)] = round(ev["commit_ms"], 4)
         pipe_ms[str(chunk)] = round(pipe, 4)
@@ -180,10 +215,13 @@ def sweep(workload: str, chunks, geometry: dict, measure) -> dict:
               f"{ms['sort'][str(chunk)]:.3f} probe "
               f"{ms['probe'][str(chunk)]:.3f} enqueue "
               f"{ms['enqueue'][str(chunk)]:.3f}) "
+              f"sort-free dedup {ms_sf['sort'][str(chunk)]:.3f} ms "
               f"pipelined {pipe_ms[str(chunk)]:.3f} ms "
               f"[{time.time() - t0:.1f}s]", file=sys.stderr)
     fits = {p: fit_linear(chunks, [ms[p][str(c)] for c in chunks])
             for p in PHASES}
+    fits_sf = {p: fit_linear(chunks, [ms_sf[p][str(c)] for c in chunks])
+               for p in PHASES}
     return {
         "version": COSTMODEL_VERSION,
         "workload": workload,
@@ -193,18 +231,35 @@ def sweep(workload: str, chunks, geometry: dict, measure) -> dict:
         "geometry": dict(geometry),
         # differential sub-phase walls (obs.phases.subphase_walls)
         "ms_per_step": ms,
+        # the same sweep with the sort-free hash-slab commit (ISSUE 12;
+        # the "sort" column is then the slab dedup stage)
+        "ms_per_step_sort_free": ms_sf,
         # measured walls decoded from `phase` journal events (the
         # PhasedRuntime path a live -phase-timing run journals)
         "phase_event_ms_per_step": events_ms,
         "pipelined_step_ms": pipe_ms,
         # the PERF-style linear model: ms(chunk) = a_ms + b_ms_per_1k *
-        # (chunk / 1024) per phase
+        # (chunk / 1024) per phase; slopes clamped nonnegative
+        # (`clamped: true` marks a refit)
         "fit": fits,
+        "fit_sort_free": fits_sf,
     }
 
 
+def _fit_line(fits: dict, label: str) -> str:
+    return (f"fit[{label}] ms(chunk) = a + b*(chunk/1024):  "
+            + "  ".join(
+                f"{p} {fits[p]['a_ms']:+.3f}{fits[p]['b_ms_per_1k']:+.3f}/1k"
+                + ("*" if fits[p].get("clamped") else "")
+                for p in ("expand", "sort", "probe", "enqueue",
+                          "commit")
+            ))
+
+
 def perf_table(doc: dict) -> str:
-    """The PERF.md-ready markdown table of a sweep document."""
+    """The PERF.md-ready markdown table of a sweep document.  A `*` on
+    a fit marks a nonnegative-slope clamp (the raw least-squares slope
+    was negative - noise through an amortized phase)."""
     chunks = doc["chunks"]
     head = ("| chunk | " + " | ".join(PHASES)
             + " | pipelined step |")
@@ -214,12 +269,27 @@ def perf_table(doc: dict) -> str:
         cells = [f"{doc['ms_per_step'][p][str(c)]:.3f}" for p in PHASES]
         cells.append(f"{doc['pipelined_step_ms'][str(c)]:.3f}")
         rows.append(f"| {c} | " + " | ".join(cells) + " |")
-    fits = doc["fit"]
+    ms_sf = doc.get("ms_per_step_sort_free")
+    if ms_sf:
+        rows.append("")
+        rows.append("sort-free commit (hash-slab dedup, same sweep):")
+        rows.append(head)
+        rows.append(sep)
+        for c in chunks:
+            cells = [f"{ms_sf[p][str(c)]:.3f}" for p in PHASES]
+            cells.append(f"{doc['pipelined_step_ms'][str(c)]:.3f}")
+            rows.append(f"| {c} | " + " | ".join(cells) + " |")
     rows.append("")
-    rows.append("fit ms(chunk) = a + b*(chunk/1024):  " + "  ".join(
-        f"{p} {fits[p]['a_ms']:+.3f}{fits[p]['b_ms_per_1k']:+.3f}/1k"
-        for p in ("expand", "sort", "probe", "enqueue", "commit")
-    ))
+    rows.append(_fit_line(doc["fit"], "sorted"))
+    if doc.get("fit_sort_free"):
+        rows.append(_fit_line(doc["fit_sort_free"], "sort-free"))
+    clamped = [p for p in PHASES if doc["fit"][p].get("clamped")] + [
+        f"{p} (sort-free)" for p in PHASES
+        if doc.get("fit_sort_free", {}).get(p, {}).get("clamped")
+    ]
+    if clamped:
+        rows.append("* slope clamped to 0 (raw least-squares slope was "
+                    f"negative): {', '.join(clamped)}")
     return "\n".join(rows) + "\n"
 
 
@@ -291,14 +361,28 @@ def main(argv=None) -> int:
         for p in PHASES:
             assert set(back["ms_per_step"][p]) == {str(c) for c in chunks}
             # the synthetic walls are exactly linear: the fitter must
-            # recover the planted coefficients
-            a, b = _SYNTH[p]
-            fit = back["fit"][p]
-            assert abs(fit["a_ms"] - a) < 1e-2, (p, fit)
-            assert abs(fit["b_ms_per_1k"] - b * 1024) < 1e-2, (p, fit)
-            assert fit["r2"] > 0.999, (p, fit)
+            # recover the planted coefficients - in both commit modes
+            for table, planted in (("fit", _SYNTH),
+                                   ("fit_sort_free", _SYNTH_SF)):
+                a, b = planted[p]
+                fit = back[table][p]
+                assert abs(fit["a_ms"] - a) < 1e-2, (table, p, fit)
+                assert abs(fit["b_ms_per_1k"] - b * 1024) < 1e-2, (
+                    table, p, fit)
+                assert fit["r2"] > 0.999, (table, p, fit)
+        # the planted sort-free dedup is 4x cheaper: the document must
+        # carry the relation the acceptance gate reads off the real run
+        big = str(max(chunks))
+        assert back["ms_per_step"]["sort"][big] >= 2 * (
+            back["ms_per_step_sort_free"]["sort"][big]
+        )
+        # a decreasing series must clamp to slope 0, loudly
+        cl = fit_linear([64, 128, 256], [3.0, 2.0, 1.0])
+        assert cl["b_ms_per_1k"] == 0.0 and cl.get("clamped"), cl
+        assert abs(cl["a_ms"] - 2.0) < 1e-9, cl
         assert back["phase_event_ms_per_step"]["commit"]
         assert "| chunk |" in perf_table(back)
+        assert "sort-free commit" in perf_table(back)
         os.unlink(args.out)
         print("costmodel tiny OK")
     else:

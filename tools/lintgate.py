@@ -22,6 +22,34 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+# every engine factory CI expects audited (mirrors the tier-1 pin in
+# tests/test_analysis.py::test_selfcheck_registry_pinned); importing
+# the registry is jax-free, so this stays an engine-free gate
+REQUIRED_FACTORIES = (
+    "covered", "enumerator", "fused", "narrowed", "phased",
+    "pipelined", "sharded", "sortfree", "spill", "struct", "sweep",
+)
+
+
+def check_factories() -> int:
+    """Engine-free registry pin: every REQUIRED factory (the sort-free
+    commit engine included, ISSUE 12) must be registered for the
+    `python -m jaxtlc.analysis --self-check` audit - a commit that
+    drops one fails here before any engine builds."""
+    from jaxtlc.analysis.selfcheck import FACTORIES
+
+    missing = sorted(set(REQUIRED_FACTORIES) - set(FACTORIES))
+    if missing:
+        print(f"lintgate: selfcheck registry is missing {missing} - "
+              "the factory would ship unaudited", file=sys.stderr)
+        return 1
+    print(f"lintgate: selfcheck registry covers "
+          f"{len(REQUIRED_FACTORIES)} factories"
+          " (run `python -m jaxtlc.analysis --self-check --tiny` for "
+          "the full audit)")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     root = argv[0] if argv else os.path.join(
@@ -30,7 +58,8 @@ def main(argv=None) -> int:
     )
     from jaxtlc.analysis.gate import run_gate
 
-    return run_gate(root)
+    rc = run_gate(root)
+    return rc or check_factories()
 
 
 if __name__ == "__main__":
