@@ -82,6 +82,15 @@ class CheckRequest:
     noTool: bool = False
     traceExpressions: str = ""
     mutation: str = ""
+    # incremental re-checking (struct.artifacts, ISSUE 13): the
+    # content-addressed verdict + reachable-set cache.  artifactcache
+    # overrides the store directory ("" = JAXTLC_ARTIFACT_CACHE or
+    # ~/.cache/jaxtlc/artifacts); noartifactcache disables both tiers
+    # for this run; recheck forces a cache BYPASS on read (the run
+    # still refreshes the artifacts it produces)
+    artifactcache: str = ""
+    noartifactcache: bool = False
+    recheck: bool = False
     # -- library-only knobs (no CLI flag) -------------------------------
     # MC.cfg-style constant overrides applied on top of the config's
     # baked values (the serve path: a job's constants must shape the
@@ -516,7 +525,7 @@ def _preflight_gate(args, log, build_report):
     return None
 
 
-def _sup_opts(args, log):
+def _sup_opts(args, log, capture_fps: bool = False):
     """SupervisorOptions from the request.  Every supervisor event is
     written to the run journal FIRST (the single source of truth), then
     the TLC-style banner is rendered as a derived view of that journal
@@ -551,6 +560,7 @@ def _sup_opts(args, log):
         spill=args.spill,
         phase_timing=args.phasetiming,
         faults=FaultPlan.parse(args.faults) if args.faults else None,
+        capture_fps=capture_fps,
         on_event=on_event,
     )
 
@@ -891,6 +901,15 @@ def _run_check_struct(args, spec) -> int:
         if not bounds.certified:
             bounds = None
 
+    # incremental re-checking (ISSUE 13): the artifact plan decides,
+    # BEFORE any engine build, whether this check can be answered from
+    # the verdict tier (unchanged spec -> cached CheckOutcome) or the
+    # reachable-set tier (invariant-only edit -> BFS-free vmapped
+    # invariant pass).  Resume/fault/mutation/coverage/profiling runs
+    # opt out - they exist to exercise the engines themselves.
+    art_plan = _artifact_plan(args, spec, sm, bounds)
+    capture = art_plan is not None and not args.sharded
+
     def check():
         log = log_holder[0]
         ckd = spec.check_deadlock
@@ -936,13 +955,14 @@ def _run_check_struct(args, spec) -> int:
                 pipeline=args.pipeline,
                 obs_slots=_obs_slots(args),
                 sort_free=args.sortfree,
-                opts=_sup_opts(args, log), **kw,
+                opts=_sup_opts(args, log, capture_fps=capture), **kw,
             )
             return sup.result, sup
         return check_struct(
             sm, fp_index=spec.fp_index, check_deadlock=ckd,
             pipeline=args.pipeline, obs_slots=_obs_slots(args),
-            bounds=bounds, coverage=cov, sort_free=args.sortfree, **kw,
+            bounds=bounds, coverage=cov, sort_free=args.sortfree,
+            capture_fps=capture, **kw,
         ), None
 
     def props():
@@ -1009,8 +1029,34 @@ def _run_check_struct(args, spec) -> int:
         preflight=lambda deep: _struct_preflight(args, spec, sm, deep),
         coverage_device=coverage_device,
         dead_site_lint=dead_site_lint,
+        artifact_plan=art_plan,
     )
     return _run_check_interp(args, spec, kit, log_holder=log_holder)
+
+
+def _artifact_plan(args, spec, sm, bounds):
+    """The incremental-re-checking plan for a struct run (ISSUE 13), or
+    None when the run is ineligible: resume/fault/mutation runs exist
+    to exercise the engines, coverage/phase-timing/xprof runs produce
+    run-shaped artifacts a cached verdict cannot, and -no-artifact-cache
+    (or JAXTLC_ARTIFACT_CACHE=off) disables the store outright."""
+    if (args.recover or args.faults or args.mutation or args.coverage
+            or args.phasetiming or args.xprof):
+        return None
+    from .struct import artifacts as _arts
+
+    store = _arts.store_for(args)
+    if store is None:
+        return None
+    return _arts.ArtifactPlan(
+        store, sm,
+        check_deadlock=spec.check_deadlock,
+        properties=tuple(spec.properties),
+        fp_capacity=args.fpcap,
+        bounds=bounds,
+        fp_index=spec.fp_index,
+        bypass_read=bool(args.recheck),
+    )
 
 
 def _struct_dead_sites(args, spec, sm, bounds, r):
@@ -1096,7 +1142,8 @@ class _InterpKit:
                  properties, check_leads_to, fairness_label,
                  state_to_tla, state_env, violation_trace,
                  coverage=None, action_order=None, preflight=None,
-                 coverage_device=None, dead_site_lint=None):
+                 coverage_device=None, dead_site_lint=None,
+                 artifact_plan=None):
         self.kind = kind
         self.extra_unsupported = extra_unsupported
         self.check = check  # () -> (CheckResult, SupervisedResult | None)
@@ -1114,6 +1161,10 @@ class _InterpKit:
         self.coverage_device = coverage_device
         # (r) -> analysis-event dicts for zero-visit reachable sites
         self.dead_site_lint = dead_site_lint
+        # struct.artifacts.ArtifactPlan | None: the incremental
+        # re-checking seam (verdict/reach lookup before any engine
+        # build, clean-verdict artifact write after)
+        self.artifact_plan = artifact_plan
 
 
 def _run_check_interp(args, spec, kit: "_InterpKit",
@@ -1157,7 +1208,28 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
                     sort_free=_sort_free(args),
                     obs_slots=_obs_slots(args)),
     )
-    if kit.preflight is not None:
+    # incremental re-checking (ISSUE 13): try the artifact tiers BEFORE
+    # preflight or any engine build.  A verdict hit swaps the engine
+    # dispatch for the cached result (and stands in for the temporal
+    # checks the cached clean verdict already attests); a reach hit
+    # swaps it for the BFS-free invariant pass.  Everything downstream
+    # - transcript, journal, violation traces - runs unchanged, so a
+    # cached answer renders exactly like a fresh run.
+    cache_tier = None
+    plan = kit.artifact_plan
+    if plan is not None:
+        fast = plan.fast_check(getattr(args, "_journal", None), log)
+        if fast is not None:
+            cache_tier, fast_fn, n_init_cached = fast
+            kit.check = fast_fn
+            kit.init_count = lambda: n_init_cached
+            if cache_tier == "verdict":
+                from .struct.artifacts import _PropertyHolds
+
+                kit.check_leads_to = (
+                    lambda name, p, q, **_kw: _PropertyHolds()
+                )
+    if kit.preflight is not None and cache_tier != "verdict":
         rc = _preflight_gate(args, log, kit.preflight)
         if rc is not None:
             return rc
@@ -1346,6 +1418,23 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
     log.final_counts(r.generated, r.distinct, r.queue_left)
     log.depth(r.depth)
     log.finished(int((time.time() - t0) * 1000))
+    if (plan is not None and not violated and not liveness_violated
+            and (sup is None or not (sup.interrupted
+                                     or getattr(sup, "exhausted",
+                                                False)))):
+        # the clean-final-verdict write point: error/violation/
+        # interrupted/exhausted runs never reach this branch, and
+        # record() re-checks violation + certificate itself
+        try:
+            plan.record(
+                r, n_init=n_init,
+                journal=getattr(args, "_journal", None),
+                action_order=(kit.action_order()
+                              if kit.action_order is not None else None),
+            )
+        except OSError as e:  # a full disk must not fail the verdict
+            log.msg(1000, f"Warning: artifact cache write failed: {e}",
+                    severity=1)
     _finish_journal(
         args, log, r=r, sup=sup,
         verdict="liveness_violation" if liveness_violated else None,

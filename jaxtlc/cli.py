@@ -173,6 +173,28 @@ def main(argv=None) -> int:
                    action="store_true",
                    help="disable the persistent compile cache for this "
                         "run")
+    c.add_argument("-artifact-cache", dest="artifactcache", default="",
+                   metavar="DIR",
+                   help="incremental re-checking artifact store "
+                        "(struct frontend): cached VERDICTS keyed on "
+                        "the spec's semantic digest (an unchanged spec "
+                        "returns its verdict without building an "
+                        "engine) and cached REACHABLE SETS keyed on "
+                        "the behavior digest (an invariant-only edit "
+                        "skips BFS and re-evaluates just the "
+                        "invariants).  Default ~/.cache/jaxtlc/"
+                        "artifacts, or $JAXTLC_ARTIFACT_CACHE (=off "
+                        "disables); artifacts are CRC-verified and "
+                        "written only on clean verdicts - "
+                        "tools/cachectl.py lists/verifies/GCs them")
+    c.add_argument("-no-artifact-cache", dest="noartifactcache",
+                   action="store_true",
+                   help="disable the artifact cache (both tiers) for "
+                        "this run")
+    c.add_argument("-recheck", action="store_true",
+                   help="force a full re-check: bypass the artifact "
+                        "cache on read (the run still refreshes the "
+                        "artifacts it produces)")
     c.add_argument("-obs", dest="obs", action="store_true", default=True,
                    help="(default) carry the on-device observability "
                         "counter ring: one per-level telemetry row "

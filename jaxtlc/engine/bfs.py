@@ -181,6 +181,12 @@ class CheckResult(NamedTuple):
     # state satisfied the certified bounds; True = a claimed bound was
     # VIOLATED - the check drivers escalate this to an error verdict
     cert_violated: bool = None
+    # final fingerprint-table words ([n_buckets, 2*BUCKET] uint32 on
+    # host), captured ONLY when the artifact cache wants to derive the
+    # reachable-set tier from a clean single-device run
+    # (struct.artifacts.states_from_table); None everywhere else so
+    # results stay light
+    fp_table: object = None
 
 
 def carry_done(carry: EngineCarry) -> bool:

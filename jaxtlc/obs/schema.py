@@ -108,6 +108,14 @@ EVENTS = {
     "analysis_summary": {"name": _STR, "findings": _NUM,
                          "errors": _NUM, "warnings": _NUM,
                          "wall_s": _NUM},
+    # -- incremental re-checking (struct.artifacts, ISSUE 13) --------------
+    # one per artifact-cache decision: tier in ("verdict", "reach"),
+    # outcome in ("hit", "miss", "write", "bypass", "skip", "corrupt"),
+    # key = the content-address digest.  A "hit" on the verdict tier
+    # means the run's result was replayed from the cache (no engine was
+    # built); on the reach tier it means BFS was skipped and only the
+    # invariants were re-evaluated over the stored reachable set
+    "cache": {"tier": _STR, "outcome": _STR, "key": _STR},
     # -- derived artifacts -------------------------------------------------
     "trace_export": {"path": _STR, "events": _NUM},
     # one bench.py metric payload (the BENCH_*.json line contract)

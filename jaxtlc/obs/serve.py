@@ -280,6 +280,24 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 self._send(200, json.dumps(cov).encode(),
                            "application/json")
+            elif route == "/cache":
+                # incremental re-checking (ISSUE 13): the process
+                # artifact store's counters + content listing.  In a
+                # serving/run process this is the store its checks use;
+                # a standalone monitor reports the default store on
+                # disk (the same files cachectl ls shows)
+                from ..struct.artifacts import get_store
+
+                store = get_store()
+                if store is None:
+                    body = json.dumps({"enabled": False}).encode()
+                else:
+                    body = json.dumps({
+                        "enabled": True,
+                        "stats": store.stats(),
+                        "entries": store.ls(),
+                    }).encode()
+                self._send(200, body, "application/json")
             elif route == "/events":
                 self._events(qs)
             elif route == "/":
@@ -288,6 +306,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "  /runs     run registry (JSON)\n"
                     "  /metrics  Prometheus text   [?run=NAME]\n"
                     "  /coverage live per-site coverage [?run=NAME]\n"
+                    "  /cache    artifact-cache stats + contents\n"
                     "  /events   SSE journal tail  [?run=NAME]"
                     "[&once=1][&since=N]\n"
                     "  /journal  raw JSONL         [?run=NAME]\n"

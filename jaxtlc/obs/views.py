@@ -89,6 +89,16 @@ def metrics_from_events(events) -> dict:
         "retries_total": counts.get("retry", 0),
         "degrades_total": counts.get("degrade", 0),
     }
+    cache_evs = [e for e in events if e["event"] == "cache"]
+    if cache_evs:
+        # incremental re-checking (ISSUE 13): this run's artifact-cache
+        # decisions as Prometheus counters (jaxtlc_artifact_cache_*)
+        out["artifact_cache_hit_total"] = sum(
+            1 for e in cache_evs if e.get("outcome") == "hit"
+        )
+        out["artifact_cache_miss_total"] = sum(
+            1 for e in cache_evs if e.get("outcome") == "miss"
+        )
     manifest = next((e for e in events if e["event"] == "run_start"),
                     None)
     fin = next((e for e in reversed(events) if e["event"] == "final"),
@@ -208,6 +218,20 @@ def render_tlc_event(log, ev: dict, resume_cmd: str = "") -> None:
                 "distinct totals beyond this level may have wrapped.",
                 severity=1,
             )
+    elif kind == "cache" and ev.get("outcome") == "hit":
+        # incremental re-checking (ISSUE 13): loud when a run was
+        # answered (or BFS-skipped) from the artifact cache - misses,
+        # writes and bypasses stay journal-only
+        what = ("verdict replayed from the artifact cache (no engine "
+                "was built)" if ev["tier"] == "verdict" else
+                "reachable set loaded from the artifact cache; "
+                "re-evaluating invariants only (BFS skipped)")
+        log.msg(
+            1000,
+            f"Incremental re-check: {what}  [key "
+            f"{ev['key'][:12]}..., -recheck forces a full run]",
+            severity=1,
+        )
     elif kind == "checkpoint":
         log.checkpoint_saved(ev["path"])
     elif kind == "recovery":

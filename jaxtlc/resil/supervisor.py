@@ -200,6 +200,12 @@ class SupervisorOptions:
     # journal event with saturated=true is emitted (the live "the spec
     # stopped exploring new behavior" cue; only with a coverage plane)
     coverage_sat_levels: int = 8
+    # artifact cache (struct.artifacts): read the final fingerprint
+    # table back to host on a CLEAN verdict so the reachable-set tier
+    # can be derived from it.  Single-device non-spilled runs only -
+    # the spill tier's table is partial and the sharded carry is
+    # per-device (CAPTURES_FPS on the adapter gates it)
+    capture_fps: bool = False
     # on_event(kind, info_dict): checkpoint / ckpt_write_failed / recovery
     # / regrow / retry / interrupted / progress / spill / degrade /
     # exhausted - the tlc_log banner seam
@@ -265,6 +271,10 @@ class SingleDeviceAdapter:
     ModelConfig stanza in the checkpoint meta."""
 
     kind = "single"
+    # the artifact cache may read this adapter's final fpset table back
+    # (one table, whole reachable set; the sharded adapter's carry is
+    # per-device and stays uncaptured)
+    CAPTURES_FPS = True
     GEOM_KEYS = ("queue_capacity", "fp_capacity")
     FIXED_KEYS = ("format", "config", "chunk", "fp_index", "seed",
                   "fp_highwater", "pipeline", "obs_slots", "coverage",
@@ -1212,6 +1222,15 @@ def supervise(adapter, params: dict,
     verdict = ("exhausted" if exhausted
                else "interrupted" if interrupted
                else "violation" if result.violation != OK else "ok")
+    if (opts.capture_fps and verdict == "ok" and spill_rt is None
+            and getattr(adapter, "CAPTURES_FPS", False)
+            and getattr(carry, "fps", None) is not None):
+        # the artifact cache's reachable-set source: one host copy of
+        # the final table, only on a clean non-spilled single-device
+        # verdict (a spilled run's device table is partial)
+        result = result._replace(
+            fp_table=np.asarray(jax.device_get(carry.fps.table))
+        )
     _emit(opts, "final", verdict=verdict, generated=result.generated,
           distinct=result.distinct, depth=result.depth,
           queue=result.queue_left, wall_s=round(wall, 6),

@@ -29,6 +29,12 @@ def main(argv=None) -> int:
     p.add_argument("--large-fpcap", type=int, default=None,
                    help="fp_capacity above which a job routes through "
                         "the resil supervisor instead of the pool")
+    p.add_argument("--prewarm", default="", metavar="SPEC:CFG[,...]",
+                   help="comma-separated cfg paths (or SPEC:CFG pairs) "
+                        "to compile into the pool at startup, so the "
+                        "FIRST submit of each rides the warm path "
+                        "(compiled at the pooled-path default geometry "
+                        "in a background thread; progress on /pool)")
     p.add_argument("--tiny", action="store_true",
                    help="smoke: serve + submit + assert warm reuse, "
                         "then exit")
@@ -41,6 +47,7 @@ def main(argv=None) -> int:
         args.root, port=args.port, host=args.host,
         pool_capacity=args.pool_cap, sweep_width=args.sweep_width,
         large_fpcap=args.large_fpcap,
+        prewarm=[s for s in args.prewarm.split(",") if s],
     )
     print(f"jaxtlc checking service at {srv.url} "
           f"(POST /jobs; GET /jobs /pool /runs /metrics /events; "

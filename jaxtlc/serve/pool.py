@@ -127,7 +127,7 @@ class _SingleRunner:
         carry0 = self._mk_carry()
         self._aot = run_fn.lower(carry0).compile()
 
-    def run(self):
+    def run(self, capture_fps: bool = False):
         import jax
 
         from ..engine.bfs import result_from_carry
@@ -137,11 +137,20 @@ class _SingleRunner:
         t0 = time.time()
         out = jax.block_until_ready(self._aot(carry))
         wall = time.time() - t0
-        return result_from_carry(
+        result = result_from_carry(
             out, wall, fp_capacity=self.fp_capacity,
             labels=self.backend.labels,
             viol_names=struct_viol_names(self.model),
         )
+        if capture_fps and result.violation == 0:
+            # the artifact cache's reachable-set source (ISSUE 13):
+            # one host copy of the final table, clean verdicts only
+            import numpy as np
+
+            result = result._replace(
+                fp_table=np.asarray(jax.device_get(out.fps.table))
+            )
+        return result
 
 
 class EnginePool:
@@ -161,6 +170,11 @@ class EnginePool:
         self.evictions = 0
         self.compiles = 0  # pool-level builds (one per miss)
         self.compile_wall_s = 0.0
+        # --prewarm accounting (ISSUE 13 satellite): engines compiled
+        # ahead of traffic so the FIRST submit rides the warm path
+        self.prewarmed = 0
+        self.prewarm_errors = 0
+        self.prewarm_wall_s = 0.0
         CompileMeter.instance()  # start metering before the first build
 
     # -- lookup ------------------------------------------------------------
@@ -261,6 +275,49 @@ class EnginePool:
                  params={c: list(d) for c, d in sorted(params.items())}),
         )
 
+    # -- prewarm (ISSUE 13 satellite) --------------------------------------
+
+    def prewarm(self, specs, chunk: int = None, queue_capacity: int = None,
+                fp_capacity: int = None) -> dict:
+        """Compile the listed models into the pool ahead of traffic.
+
+        `specs` is a list of ``CFG`` paths (or ``SPEC:CFG`` pairs - the
+        spec half is informational; the loader reads the sibling .tla
+        from the cfg's directory anyway).  Geometry defaults to the
+        scheduler's pooled-path defaults, so a prewarmed engine and a
+        default submit land on the SAME pool key: the first submit of a
+        prewarmed spec rides the disk-warm/AOT path (0.77 s class)
+        instead of the true-cold path (4.8 s class, PERF.md round 12).
+        Errors are counted, never fatal - a bad prewarm entry must not
+        stop the server."""
+        from ..struct.loader import load
+        from .scheduler import DEFAULT_CHUNK, DEFAULT_FPCAP, DEFAULT_QCAP
+
+        chunk = chunk or DEFAULT_CHUNK
+        queue_capacity = queue_capacity or DEFAULT_QCAP
+        fp_capacity = fp_capacity or DEFAULT_FPCAP
+        report = {"ok": [], "errors": []}
+        for item in specs:
+            cfg = item.split(":", 1)[1] if ":" in item else item
+            t0 = time.time()
+            try:
+                model = load(cfg)
+                self.get_single(model, chunk=chunk,
+                                queue_capacity=queue_capacity,
+                                fp_capacity=fp_capacity)
+            except Exception as e:  # noqa: BLE001 - count, don't die
+                with self._lock:
+                    self.prewarm_errors += 1
+                report["errors"].append(f"{cfg}: {e}")
+                continue
+            wall = time.time() - t0
+            with self._lock:
+                self.prewarmed += 1
+                self.prewarm_wall_s += wall
+            report["ok"].append(dict(cfg=cfg, workload=model.root_name,
+                                     wall_s=round(wall, 3)))
+        return report
+
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> dict:
@@ -283,6 +340,9 @@ class EnginePool:
                 evictions=self.evictions,
                 compiles=self.compiles,
                 compile_wall_s=round(self.compile_wall_s, 6),
+                prewarmed=self.prewarmed,
+                prewarm_errors=self.prewarm_errors,
+                prewarm_wall_s=round(self.prewarm_wall_s, 6),
                 xla_compiles=meter.count,
                 xla_compile_wall_s=round(meter.wall_s, 6),
                 xla_meter="ok" if meter.available else "unavailable",

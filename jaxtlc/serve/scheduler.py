@@ -15,6 +15,15 @@ constants-class sweep engine.  Everything else runs alone:
   `api.run_check`, i.e. the resil supervisor with auto-regrow, the
   degradation ladder, and the full TLC transcript.
 
+Before any of that, the incremental re-checking cache
+(struct.artifacts, ISSUE 13) gets first refusal on pooled jobs: an
+unchanged spec is answered from the verdict tier in O(HTTP) (job
+engine "cache" - no pool lookup, no engine dispatch), and a spec with
+a stored reachable set routes through api.run_check's reach tier,
+which skips BFS and re-evaluates only the invariants.  Sweep jobs
+bypass the cache (their per-config results live in one vmapped
+dispatch; caching them is a per-lane story for later).
+
 Every job writes its own journal into the server root - the /runs
 registry and the job-scoped SSE stream (`/events?run=<job id>`) are the
 existing obs.serve machinery reading those files.  Scheduler-run jobs
@@ -40,12 +49,19 @@ from .pool import EnginePool
 JOB_FSYNC_EVERY = 16  # batched-fsync journals for scheduler-run jobs
 DEFAULT_LARGE_FPCAP = 1 << 16  # above this, a job is "large"
 
+# the pooled path's default engine geometry - ALSO the geometry
+# `--prewarm` compiles against, so a prewarmed engine and a default
+# submit land on the same pool key
+DEFAULT_CHUNK = 64
+DEFAULT_QCAP = 1 << 10
+DEFAULT_FPCAP = 1 << 12
+
 # job options forwarded to api.CheckRequest on the supervised path
 _REQUEST_OPTIONS = (
     "workers", "frontend", "chunk", "qcap", "fpcap", "pipeline",
     "sortfree", "sharded", "checkpoint", "recover", "liveness",
     "fairness", "nodeadlock", "faults", "retry", "maxregrow", "spill",
-    "obs", "obsslots", "coverage",
+    "obs", "obsslots", "coverage", "recheck", "noartifactcache",
 )
 _HEAVY_OPTIONS = ("checkpoint", "recover", "sharded", "liveness",
                   "faults", "coverage")
@@ -169,6 +185,7 @@ class Scheduler:
         self._stop = False
         self.batches_run = 0
         self.batched_jobs = 0
+        self.cache_hits = 0  # jobs answered from the artifact cache
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -207,6 +224,7 @@ class Scheduler:
             return dict(jobs=len(self.jobs), queued=len(self._queue),
                         states=states, batches_run=self.batches_run,
                         batched_jobs=self.batched_jobs,
+                        cache_hits=self.cache_hits,
                         large_fpcap=self.large_fpcap)
 
     def drain(self, timeout: float = 60.0) -> bool:
@@ -299,9 +317,9 @@ class Scheduler:
     def _geometry(self, job: Job) -> dict:
         o = job.options
         return dict(
-            chunk=int(o.get("chunk", 64)),
-            queue_capacity=int(o.get("qcap", 1 << 10)),
-            fp_capacity=int(o.get("fpcap", 1 << 12)),
+            chunk=int(o.get("chunk", DEFAULT_CHUNK)),
+            queue_capacity=int(o.get("qcap", DEFAULT_QCAP)),
+            fp_capacity=int(o.get("fpcap", DEFAULT_FPCAP)),
             check_deadlock=not o.get("nodeadlock", False),
             sort_free=o.get("sortfree", None),
         )
@@ -364,9 +382,16 @@ class Scheduler:
 
     def _run_pooled(self, job: Job) -> None:
         """Warm plain engine via the pool; falls back to the supervised
-        path when the spec does not resolve structurally."""
+        path when the spec does not resolve structurally.
+
+        Incremental re-checking (ISSUE 13) sits BEFORE pool routing: an
+        unchanged spec is answered from the verdict tier in O(HTTP) -
+        no pool lookup, no engine dispatch - and a spec whose behavior
+        digest has a stored reachable set routes through api.run_check,
+        which skips BFS and re-evaluates only the invariants."""
         import jax
 
+        from ..struct import artifacts as arts
         from ..struct.loader import StructLoadError, load
         from ..struct.parser import StructParseError
 
@@ -380,6 +405,29 @@ class Scheduler:
             self._run_supervised(job)
             return
         geo = self._geometry(job)
+        store = arts.get_store()
+        use_cache = (store is not None
+                     and not job.options.get("recheck")
+                     and not job.options.get("noartifactcache"))
+        vkey = ""
+        if use_cache:
+            # the pooled path checks safety only, so its verdict key
+            # carries an empty property selection (api keys runs WITH
+            # properties differently - the two can never cross-answer)
+            vkey = arts.verdict_key(model, geo["check_deadlock"])
+            payload = store.lookup_verdict(vkey)
+            if payload is not None:
+                self._finish_cached(job, geo, vkey, payload)
+                return
+            if store.has_reach(
+                    arts.reach_key(model, geo["check_deadlock"])):
+                # invariant-only edit: api.run_check's reach tier
+                # skips BFS entirely - cheaper than a pool dispatch.
+                # Forced onto the struct frontend: the stored artifact
+                # was keyed by this very struct load, and "auto" could
+                # route a gen-subset spec away from the cache
+                self._run_supervised(job, frontend="struct")
+                return
         pre = self.pool.hits
         entry = self.pool.get_single(model, **geo)
         hit = self.pool.hits > pre
@@ -389,13 +437,23 @@ class Scheduler:
                  params=dict(**geo, constants=job.constants,
                              pool_hit=hit))
         try:
-            r = entry.runner.run()
+            r = entry.runner.run(capture_fps=use_cache)
         except BaseException:
             self._abort_journals([jr])
             raise
         if r.violation != 0:
             jr.event("violation", code=int(r.violation),
                      name=r.violation_name)
+        if use_cache and r.violation == 0:
+            try:
+                arts.ArtifactPlan(
+                    store, model,
+                    check_deadlock=geo["check_deadlock"],
+                    fp_capacity=geo["fp_capacity"],
+                ).record(r, n_init=len(model.system.initial_states()),
+                         journal=jr)
+            except OSError:
+                pass  # a full disk must not fail the job
         jr.event("final",
                  verdict="ok" if r.violation == 0 else "violation",
                  generated=r.generated, distinct=r.distinct,
@@ -403,6 +461,33 @@ class Scheduler:
                  wall_s=round(r.wall_s, 6), interrupted=False)
         jr.close()
         self._finish_ok(job, _result_dict(r, "pool", pool_hit=hit))
+
+    def _finish_cached(self, job: Job, geo: dict, key: str,
+                       payload: dict) -> None:
+        """Answer a job from the verdict tier: journal a complete run
+        (run_start -> cache hit -> final, so SSE/views/tlcstat render
+        it like any other), no pool lookup, no engine dispatch."""
+        from ..struct.artifacts import result_from_payload
+
+        jr = self._journal(job)
+        jr.event("run_start", version=_version(), workload=job.name,
+                 engine="cache", device="artifact-cache",
+                 params=dict(**geo, constants=job.constants,
+                             cache_hit=True))
+        jr.event("cache", tier="verdict", outcome="hit", key=key,
+                 workload=payload.get("workload"))
+        r = result_from_payload(payload,
+                                fp_capacity=geo["fp_capacity"],
+                                wall_s=time.time() - job.started_t)
+        jr.event("final", verdict="ok", generated=r.generated,
+                 distinct=r.distinct, depth=r.depth, queue=r.queue_left,
+                 wall_s=round(r.wall_s, 6), interrupted=False)
+        jr.close()
+        with self._cond:
+            self.cache_hits += 1
+        res = _result_dict(r, "cache")
+        res["cache_hit"] = True
+        self._finish_ok(job, res)
 
     def _abort_journals(self, journals) -> None:
         """A runner that dies after the per-job journals opened must
@@ -419,10 +504,12 @@ class Scheduler:
             finally:
                 jr.close()
 
-    def _run_supervised(self, job: Job) -> None:
+    def _run_supervised(self, job: Job, frontend: str = None) -> None:
         """Large / resilience-option jobs: the full api.run_check
         pipeline (resil supervisor, degradation ladder, preflight, TLC
-        transcript captured as the job's output)."""
+        transcript captured as the job's output).  `frontend` overrides
+        the resolver when the caller already knows the path (the
+        artifact-cache reach route struct-loaded the model itself)."""
         from ..api import CheckRequest, run_check
 
         cfg_path = self._jobdir(job)
@@ -430,6 +517,8 @@ class Scheduler:
         kw = {k: job.options[k] for k in _REQUEST_OPTIONS
               if k in job.options}
         kw.setdefault("workers", "cpu" if _on_cpu() else "tpu")
+        if frontend is not None:
+            kw.setdefault("frontend", frontend)
         req = CheckRequest(
             config=cfg_path,
             constants=_loader_constants(job.constants),

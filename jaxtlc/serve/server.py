@@ -110,7 +110,7 @@ class CheckServer:
     def __init__(self, root: Optional[str] = None, port: int = 0,
                  host: str = "127.0.0.1", pool: EnginePool = None,
                  pool_capacity: int = 8, sweep_width: int = None,
-                 large_fpcap: int = None):
+                 large_fpcap: int = None, prewarm: list = None):
         from http.server import ThreadingHTTPServer
 
         from .scheduler import DEFAULT_LARGE_FPCAP
@@ -123,6 +123,13 @@ class CheckServer:
             self.root, pool=self.pool,
             large_fpcap=large_fpcap or DEFAULT_LARGE_FPCAP,
         )
+        if prewarm:
+            # compile ahead of traffic WITHOUT blocking startup; /pool's
+            # prewarmed counter reports progress (ISSUE 13 satellite)
+            threading.Thread(
+                target=self.pool.prewarm, args=(list(prewarm),),
+                daemon=True,
+            ).start()
         handler = type("BoundJobHandler", (_JobHandler,),
                        {"root": self.root, "scheduler": self.scheduler})
         self.httpd = ThreadingHTTPServer((host, port), handler)

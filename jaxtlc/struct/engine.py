@@ -56,13 +56,17 @@ def check_struct(
     bounds=None,
     coverage: bool = False,
     sort_free: bool = None,
+    capture_fps: bool = False,
 ) -> CheckResult:
     """Exhaustive device check of a struct-compiled spec (single device,
     fused loop; AOT-compiled before timing like bfs.check).  `bounds`
     (a certified analysis.absint.BoundReport) runs the NARROWED engine
     with the runtime certificate check on; `coverage` the covered
     engine (device per-site coverage on CheckResult.site_coverage);
-    `sort_free` the hash-slab commit (bit-identical results)."""
+    `sort_free` the hash-slab commit (bit-identical results);
+    `capture_fps` reads the final fingerprint table back to host on a
+    clean verdict (CheckResult.fp_table - the artifact cache's
+    reachable-set source, struct.artifacts)."""
     init_fn, run_fn, _ = get_engine(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
@@ -76,11 +80,18 @@ def check_struct(
     t0 = time.time()
     out = jax.block_until_ready(compiled(carry))
     wall = time.time() - t0
-    return result_from_carry(
+    result = result_from_carry(
         out, wall, fp_capacity=fp_capacity, labels=backend.labels,
         viol_names=backend.viol_names,
         sites=backend.coverage.sites if backend.coverage else None,
     )
+    if capture_fps and result.violation == 0:
+        import numpy as np
+
+        result = result._replace(
+            fp_table=np.asarray(jax.device_get(out.fps.table))
+        )
+    return result
 
 
 def check_struct_sharded(

@@ -20,6 +20,13 @@ os.environ["XLA_FLAGS"] = (
 # (jaxtlc.analysis.donation; ISSUE 6 satellite)
 os.environ.setdefault("JAXTLC_DEBUG_DONATION", "1")
 
+# incremental re-checking stays OFF by default under test: a shared
+# ~/.cache store would let one test's verdict artifact short-circuit
+# another's engine run (the warm-pool and parity pins depend on the
+# engines actually executing).  tests/test_artifacts.py and the tool
+# tinies opt IN against tmp-dir stores via struct.artifacts.configure
+os.environ.setdefault("JAXTLC_ARTIFACT_CACHE", "off")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,509 @@
+"""Incremental re-checking tests (ISSUE 13, jaxtlc/struct/artifacts.py).
+
+Budget discipline (tier-1 runs ~800 s of its 870 s ceiling): ONE
+module-scoped engine compile owns the fresh-run fixture (raw engine
+path at the serve pool's default geometry, so the server tests' pool
+entries share the same engine memo), plus one deliberately-paid tiny
+compile for the seeded-violation FULL-run baseline the delta-recheck
+acceptance compares against.  Every cache-hit test asserts against
+jax's own CompileMeter, not bookkeeping.
+
+Pinned here:
+
+* cached verdict == fresh run (verdict, counters, per-action) with
+  ZERO fresh XLA compiles and no engine build;
+* invariant-only edits keep the reachable-set key (behavior digest)
+  while the verdict key changes; a clean delta recheck reports the
+  fresh run's counters bit-identically, and a seeded violation is
+  caught with the same exit code, violated invariant and trace as a
+  full run;
+* CRC-corrupt artifacts are loud misses (transcript warning + journal
+  `cache` corrupt event) that self-heal on the next clean run;
+* an ENGINE_SEMVER bump misses the whole cache;
+* violating runs never write artifacts; `-recheck` bypasses reads;
+* fingerprint inversion round-trips exactly (the reach tier's
+  correctness core);
+* server plane: --prewarm makes the FIRST submit a zero-compile pool
+  hit, the second submit is answered from the verdict tier in O(HTTP),
+  and an invariant-edited job routes through the reach tier - /cache,
+  /pool and Prometheus all report it.
+"""
+
+import io
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jaxtlc.struct import artifacts as arts
+
+SPEC = """---- MODULE ArtTiny ----
+EXTENDS Naturals
+CONSTANTS MAX
+VARIABLES x, y
+
+Init == /\\ x = 0
+        /\\ y = 0
+
+Up == /\\ x < MAX
+      /\\ x' = x + 1
+      /\\ y' = y
+
+Flip == /\\ x > 0
+        /\\ y' = 1 - y
+        /\\ x' = x
+
+Reset == /\\ x = MAX
+         /\\ x' = 0
+         /\\ y' = y
+
+Next == Up \\/ Flip \\/ Reset
+
+Spec == Init /\\ [][Next]_<<x, y>>
+
+InRange == x <= MAX
+YBit == y <= 1
+YNonNeg == y >= 0
+NoTop == x < MAX
+====
+"""
+
+
+def _cfg(*invariants):
+    return ("CONSTANT MAX = 4\nSPECIFICATION\nSpec\nINVARIANT\n"
+            + "\n".join(invariants) + "\n")
+
+
+CFG = _cfg("InRange", "YBit")
+CFG_CLEAN_EDIT = _cfg("InRange", "YBit", "YNonNeg")  # invariant-only
+CFG_SEEDED = _cfg("InRange", "YBit", "NoTop")  # NoTop is violated
+
+
+def _write_model(root, cfg_text, name="m"):
+    d = root / name
+    d.mkdir()
+    (d / "ArtTiny.tla").write_text(SPEC)
+    (d / "ArtTiny.cfg").write_text(cfg_text)
+    return str(d / "ArtTiny.cfg")
+
+
+def _run(cfg_path, journal="", **kw):
+    """api.run_check at the serve pool's default geometry (the raw
+    engine path: the ONE memoized tiny engine every test here reuses)."""
+    from jaxtlc.api import CheckRequest, run_check
+
+    out = io.StringIO()
+    req = CheckRequest(
+        config=cfg_path, workers="cpu", frontend="struct",
+        chunk=64, qcap=1 << 10, fpcap=1 << 12, autogrow=False,
+        obs=False, noTool=True, journal=journal, out=out, err=out, **kw,
+    )
+    return run_check(req), out.getvalue()
+
+
+def _cache_events(journal_path):
+    from jaxtlc.obs import journal as jr
+
+    return [(e["tier"], e["outcome"]) for e in jr.read(journal_path)
+            if e["event"] == "cache"]
+
+
+def _sig(r):
+    return (r.generated, r.distinct, r.depth, r.queue_left,
+            r.action_generated, r.action_distinct, r.outdegree)
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    token = arts.configure(
+        str(tmp_path_factory.mktemp("artifact-store"))
+    )
+    yield arts.get_store()
+    arts.restore(token)
+
+
+@pytest.fixture(scope="module")
+def fresh(store, tmp_path_factory):
+    """The module's ONE engine compile: a clean run that populates both
+    artifact tiers."""
+    root = tmp_path_factory.mktemp("fresh")
+    cfg = _write_model(root, CFG)
+    journal = str(root / "fresh.journal.jsonl")
+    outcome, transcript = _run(cfg, journal=journal)
+    assert outcome.exit_code == 0 and outcome.verdict == "ok"
+    rows = store.ls()
+    assert {r["tier"] for r in rows} == {"verdict", "reach"}, rows
+    return dict(root=root, cfg=cfg, outcome=outcome,
+                transcript=transcript, journal=journal)
+
+
+# ---------------------------------------------------------------------------
+# unit: inversion, keys, store
+# ---------------------------------------------------------------------------
+
+
+def test_fp_inversion_roundtrips_exactly():
+    """The reach tier's correctness core: for nbits <= 64 the affine
+    fingerprint map is injective and invert_fps recovers every packed
+    state bit-for-bit (through the same mix/unmix the table stores)."""
+    from jaxtlc.engine.fingerprint import (
+        DEFAULT_FP_INDEX,
+        DEFAULT_SEED,
+        affine_basis,
+    )
+
+    rng = np.random.default_rng(7)
+    for nbits in (13, 40, 64):
+        W = (nbits + 31) // 32
+        words = rng.integers(0, 2 ** 32, size=(257, W),
+                             dtype=np.uint32)
+        if nbits % 32:
+            words[:, -1] &= np.uint32((1 << (nbits % 32)) - 1)
+        const, basis = affine_basis(nbits, DEFAULT_FP_INDEX,
+                                    DEFAULT_SEED)
+        b64 = np.array(
+            [int(basis[i, 0]) | (int(basis[i, 1]) << 32)
+             for i in range(nbits)], dtype=np.uint64)
+        bits = np.zeros((words.shape[0], nbits), dtype=np.uint64)
+        for i in range(nbits):
+            bits[:, i] = (words[:, i // 32] >> np.uint32(i % 32)) & 1
+        fp = (np.bitwise_xor.reduce(bits * b64[None, :], axis=1)
+              ^ np.uint64(int(const[0]) | (int(const[1]) << 32)))
+        got = arts.invert_fps(
+            (fp & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            (fp >> np.uint64(32)).astype(np.uint32),
+            nbits, DEFAULT_FP_INDEX, DEFAULT_SEED,
+        )
+        assert got is not None and np.array_equal(got, words), nbits
+    # > 64 bits: honestly unsupported, never wrong
+    assert arts._solve_basis(65, DEFAULT_FP_INDEX, DEFAULT_SEED) is None
+
+
+def test_behavior_digest_tracks_behavior_only(tmp_path):
+    """Invariant-only edits keep the reach key; verdict key changes.
+    Editing an ACTION changes both."""
+    from jaxtlc.struct.loader import load
+
+    base = load(_write_model(tmp_path, CFG, "a"))
+    inv_edit = load(_write_model(tmp_path, CFG_CLEAN_EDIT, "b"))
+    # an invariant BODY edit (not just selection) also keeps behavior
+    spec2 = SPEC.replace("YBit == y <= 1", "YBit == y < 2")
+    d = tmp_path / "c"
+    d.mkdir()
+    (d / "ArtTiny.tla").write_text(spec2)
+    (d / "ArtTiny.cfg").write_text(CFG)
+    body_edit = load(str(d / "ArtTiny.cfg"))
+    spec3 = SPEC.replace("x' = x + 1", "x' = x + 1 - 0")
+    d = tmp_path / "e"
+    d.mkdir()
+    (d / "ArtTiny.tla").write_text(spec3)
+    (d / "ArtTiny.cfg").write_text(CFG)
+    action_edit = load(str(d / "ArtTiny.cfg"))
+
+    assert arts.reach_key(base) == arts.reach_key(inv_edit)
+    assert arts.reach_key(base) == arts.reach_key(body_edit)
+    assert arts.reach_key(base) != arts.reach_key(action_edit)
+    assert arts.verdict_key(base) != arts.verdict_key(inv_edit)
+    assert arts.verdict_key(base) != arts.verdict_key(body_edit)
+    # deadlock flag is key material on both tiers
+    assert arts.verdict_key(base, True) != arts.verdict_key(base, False)
+    assert arts.reach_key(base, True) != arts.reach_key(base, False)
+    # geometry is NOT: the key functions take none
+    assert arts.verdict_key(base) == arts.verdict_key(
+        load(_write_model(tmp_path, CFG, "f")))
+
+
+def test_engine_semver_is_key_material(tmp_path, monkeypatch):
+    from jaxtlc.struct.loader import load
+
+    model = load(_write_model(tmp_path, CFG))
+    v1, r1 = arts.verdict_key(model), arts.reach_key(model)
+    monkeypatch.setattr(arts, "ENGINE_SEMVER", arts.ENGINE_SEMVER + 1)
+    assert arts.verdict_key(model) != v1
+    assert arts.reach_key(model) != r1
+
+
+def test_store_roundtrip_corruption_and_version_skew(tmp_path,
+                                                     monkeypatch):
+    st = arts.ArtifactStore(str(tmp_path / "s"))
+    key = "ab" * 32
+    payload = dict(workload="W", verdict="ok", generated=1, distinct=1,
+                   depth=1, queue=0, n_init=1, action_generated={},
+                   action_distinct={}, action_order=[], outdegree=None,
+                   properties=[], wall_s=0.0, created_t=0.0)
+    st.put_verdict(key, payload)
+    assert st.lookup_verdict(key) == payload
+    states = np.arange(8, dtype=np.uint32).reshape(4, 2)
+    st.put_reach(key, states, dict(workload="W", codec_digest="cd",
+                                   nbits=33, generated=4, distinct=4,
+                                   depth=2, n_init=1,
+                                   action_generated={},
+                                   action_distinct={}, outdegree=None))
+    got = st.lookup_reach(key)
+    assert got is not None and np.array_equal(got[0], states)
+    # bit-flip the payload: loud miss + the corrupt file is removed so
+    # the next clean run can re-publish (self-healing store)
+    vpath = st._path("verdict", key)
+    raw = open(vpath).read().replace('"generated": 1', '"generated": 2')
+    open(vpath, "w").write(raw)
+    warned = []
+    assert st.lookup_verdict(key, warn=warned.append) is None
+    assert warned and not os.path.exists(vpath)
+    # a future engine semver is a plain miss, never corruption
+    monkeypatch.setattr(arts, "ENGINE_SEMVER", arts.ENGINE_SEMVER + 1)
+    pre = st.stats()["corrupt"]
+    assert st.lookup_reach(key) is None
+    assert st.stats()["corrupt"] == pre
+
+
+# ---------------------------------------------------------------------------
+# e2e: verdict tier
+# ---------------------------------------------------------------------------
+
+
+def test_cached_verdict_matches_fresh_with_zero_compiles(fresh, store,
+                                                         tmp_path):
+    """The acceptance pin: resubmitting an unchanged spec replays the
+    verdict - same verdict/counters as the fresh run, ZERO fresh XLA
+    compiles (CompileMeter), journal renders a complete run."""
+    from jaxtlc.serve.pool import xla_compiles
+
+    journal = str(tmp_path / "hit.journal.jsonl")
+    pre = xla_compiles()
+    outcome, transcript = _run(fresh["cfg"], journal=journal)
+    assert xla_compiles() - pre == 0, "verdict hit paid an XLA compile"
+    assert outcome.exit_code == 0 and outcome.verdict == "ok"
+    assert _sig(outcome.result) == _sig(fresh["outcome"].result)
+    assert "Incremental re-check: verdict replayed" in transcript
+    # the replayed transcript still carries the full TLC protocol
+    for needle in ("states generated", "distinct states found",
+                   "The depth of the complete state graph search"):
+        assert needle in transcript, transcript
+    assert _cache_events(journal) == [("verdict", "hit")]
+    from jaxtlc.obs import journal as jr
+
+    events = jr.read(journal)  # schema-validates every line
+    assert events[-1]["event"] == "final"
+    assert events[-1]["verdict"] == "ok"
+    assert events[-1]["distinct"] == fresh["outcome"].result.distinct
+
+
+def test_recheck_flag_bypasses_reads(fresh, tmp_path):
+    journal = str(tmp_path / "bypass.journal.jsonl")
+    outcome, transcript = _run(fresh["cfg"], journal=journal,
+                               recheck=True)
+    assert outcome.exit_code == 0
+    # the read is bypassed; the run still REFRESHES its verdict
+    # artifact (the reach artifact exists and is behavior-keyed, so
+    # it needs no rewrite)
+    assert _cache_events(journal) == [("verdict", "bypass"),
+                                      ("verdict", "write")]
+    assert "Incremental re-check" not in transcript
+    assert _sig(outcome.result) == _sig(fresh["outcome"].result)
+
+
+# ---------------------------------------------------------------------------
+# e2e: reachable-set tier (invariant-only edits)
+# ---------------------------------------------------------------------------
+
+
+def test_clean_invariant_delta_recheck_bit_identical(fresh, store,
+                                                     tmp_path):
+    """Adding a (satisfied) invariant skips BFS: the reach tier
+    re-evaluates invariants over the stored states and reports the
+    fresh run's counters bit-identically - then publishes a verdict
+    artifact for the NEW key, so the next resubmit is a verdict hit."""
+    cfg = _write_model(tmp_path, CFG_CLEAN_EDIT)
+    journal = str(tmp_path / "delta.journal.jsonl")
+    outcome, transcript = _run(cfg, journal=journal)
+    assert outcome.exit_code == 0 and outcome.verdict == "ok"
+    evs = _cache_events(journal)
+    assert ("reach", "hit") in evs and ("verdict", "miss") in evs
+    assert ("verdict", "write") in evs  # the new key is now cached
+    assert "re-evaluating invariants only (BFS skipped)" in transcript
+    assert _sig(outcome.result) == _sig(fresh["outcome"].result)
+    # second submit of the edited spec: verdict tier now answers
+    journal2 = str(tmp_path / "delta2.journal.jsonl")
+    outcome2, _ = _run(cfg, journal=journal2)
+    assert _cache_events(journal2) == [("verdict", "hit")]
+    assert _sig(outcome2.result) == _sig(fresh["outcome"].result)
+
+
+def test_seeded_violation_caught_identically_to_full_run(fresh, store,
+                                                         tmp_path):
+    """The delta recheck catches a seeded violation exactly like a full
+    run: same exit code, same violated invariant, same counterexample
+    trace (both render it through the host interpreter re-run).  The
+    full-run baseline is this module's ONE deliberate extra tiny
+    compile (a different invariant selection is a different engine)."""
+    cfg = _write_model(tmp_path, CFG_SEEDED, "recheck")
+    journal = str(tmp_path / "viol.journal.jsonl")
+    outcome, transcript = _run(cfg, journal=journal)
+    assert outcome.exit_code == 12 and outcome.verdict == "violation"
+    assert ("reach", "hit") in _cache_events(journal)
+
+    cfg_full = _write_model(tmp_path, CFG_SEEDED, "full")
+    outcome_full, transcript_full = _run(cfg_full,
+                                         noartifactcache=True)
+    assert outcome_full.exit_code == 12
+
+    def violation_section(text):
+        lines = text.splitlines()
+        start = next(i for i, ln in enumerate(lines)
+                     if "Invariant NoTop is violated" in ln)
+        end = next(i for i, ln in enumerate(lines)
+                   if ln.startswith("Progress("))
+        return lines[start:end]
+
+    assert (violation_section(transcript)
+            == violation_section(transcript_full))
+    assert outcome.result.violation == outcome_full.result.violation
+    # neither violating run published a verdict artifact
+    from jaxtlc.struct.loader import load
+
+    key = arts.verdict_key(load(cfg))
+    assert not os.path.exists(store._path("verdict", key))
+
+
+def test_corrupt_artifacts_are_loud_misses_and_self_heal(fresh, store,
+                                                         tmp_path):
+    """Bit-rot both tiers: the rerun warns (transcript + journal
+    `cache` corrupt events), falls back to a FULL run on the memoized
+    engine, and re-publishes clean artifacts."""
+    from jaxtlc.struct.loader import load
+
+    model = load(fresh["cfg"])
+    vpath = store._path("verdict", arts.verdict_key(model))
+    rpath = store._path("reach", arts.reach_key(model))
+    for p in (vpath, rpath):
+        raw = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(raw[:-5] + bytes(5))
+    journal = str(tmp_path / "corrupt.journal.jsonl")
+    pre_corrupt = store.stats()["corrupt"]
+    outcome, transcript = _run(fresh["cfg"], journal=journal)
+    assert outcome.exit_code == 0
+    assert _sig(outcome.result) == _sig(fresh["outcome"].result)
+    assert store.stats()["corrupt"] == pre_corrupt + 2
+    assert transcript.count("corrupt") >= 2
+    evs = _cache_events(journal)
+    assert ("verdict", "corrupt") in evs and ("reach", "corrupt") in evs
+    assert ("verdict", "write") in evs and ("reach", "write") in evs
+    # self-healed: both files verify clean again
+    assert all(r["ok"] for r in store.verify())
+
+
+def test_engine_semver_bump_misses_everything(fresh, store, tmp_path,
+                                              monkeypatch):
+    monkeypatch.setattr(arts, "ENGINE_SEMVER", arts.ENGINE_SEMVER + 1)
+    journal = str(tmp_path / "semver.journal.jsonl")
+    outcome, transcript = _run(fresh["cfg"], journal=journal)
+    assert outcome.exit_code == 0
+    evs = _cache_events(journal)
+    assert ("verdict", "miss") in evs and ("reach", "miss") in evs
+    assert "Incremental re-check" not in transcript
+    # fresh artifacts landed under the bumped-semver keys
+    assert ("verdict", "write") in evs and ("reach", "write") in evs
+
+
+# ---------------------------------------------------------------------------
+# e2e: serve plane (prewarm + O(HTTP) hits + reach routing)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server(fresh, tmp_path_factory):
+    """A CheckServer with its OWN (empty) artifact store and a prewarm
+    list naming the fixture's model: the pool AOT-builds from the
+    already-memoized engine, so prewarm is cheap here while still
+    exercising the real path."""
+    from jaxtlc.serve import client
+    from jaxtlc.serve.server import start_server
+
+    token = arts.configure(
+        str(tmp_path_factory.mktemp("server-store"))
+    )
+    srv = start_server(prewarm=[fresh["cfg"]])
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        st = client.pool_stats(srv.url)["pool"]
+        if st["prewarmed"] + st["prewarm_errors"] >= 1:
+            break
+        time.sleep(0.05)
+    assert st["prewarmed"] == 1 and st["prewarm_errors"] == 0, st
+    yield srv
+    srv.shutdown()
+    arts.restore(token)
+
+
+def test_server_prewarm_then_cache_hit_o_http(server, fresh):
+    """The serve-plane acceptance flow: a prewarmed spec's FIRST submit
+    is a pool hit with zero fresh XLA compiles; the SECOND submit never
+    touches the pool - verdict tier, engine="cache", still zero
+    compiles - and /cache, /pool and Prometheus all report it."""
+    from jaxtlc.serve import client
+    from jaxtlc.serve.pool import xla_compiles
+
+    r = fresh["outcome"].result
+    pre = xla_compiles()
+    cold = client.check(server.url, SPEC, CFG, name="pw-first")
+    assert xla_compiles() - pre == 0, "prewarmed submit recompiled"
+    assert cold["result"]["engine"] == "pool"
+    assert cold["result"]["pool_hit"] is True
+    assert cold["result"]["generated"] == r.generated
+
+    pre = xla_compiles()
+    hit = client.check(server.url, SPEC, CFG, name="pw-second")
+    assert xla_compiles() - pre == 0
+    assert hit["result"]["engine"] == "cache"
+    assert hit["result"]["cache_hit"] is True
+    assert (hit["result"]["generated"], hit["result"]["distinct"],
+            hit["result"]["depth"]) == (r.generated, r.distinct,
+                                        r.depth)
+    stats = client.pool_stats(server.url)
+    assert stats["scheduler"]["cache_hits"] >= 1
+    cache = client._get(server.url + "/cache")
+    assert cache["enabled"] and cache["stats"]["verdict_hits"] >= 1
+    assert {e["tier"] for e in cache["entries"]} == {"verdict",
+                                                     "reach"}
+    metrics = urllib.request.urlopen(
+        server.url + f"/metrics?run={hit['id']}", timeout=10
+    ).read().decode()
+    assert "jaxtlc_artifact_cache_hit_total 1" in metrics
+
+
+def test_server_invariant_edit_routes_through_reach_tier(server,
+                                                         fresh):
+    """An invariant-only edited job skips BFS on the serve path too:
+    the scheduler sees a reachable-set artifact for the behavior digest
+    and routes through api.run_check's reach tier (no engine build -
+    CompileMeter-asserted up to the tiny invariant-pass jit, which is
+    memoized from the api tests)."""
+    from jaxtlc.obs import journal as jr
+    from jaxtlc.serve import client
+
+    st = client.check(server.url, SPEC, CFG_CLEAN_EDIT, name="pw-edit")
+    assert st["state"] == "done", st
+    assert st["result"]["engine"] == "supervised"
+    assert st["result"]["verdict"] == "ok"
+    r = fresh["outcome"].result
+    assert (st["result"]["generated"], st["result"]["distinct"]) == \
+        (r.generated, r.distinct)
+    events = jr.read(os.path.join(server.root,
+                                  f"{st['id']}.journal.jsonl"))
+    evs = [(e["tier"], e["outcome"]) for e in events
+           if e["event"] == "cache"]
+    assert ("reach", "hit") in evs
+    # tlcstat renders the cache line from the same journal
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tlcstat", os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools", "tlcstat.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    frame = mod.render(events)
+    assert "artifact cache:" in frame and "[reach]" in frame

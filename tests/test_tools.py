@@ -152,6 +152,35 @@ def test_loadgen_tiny_smoke(capsys):
     assert report["scheduler"]["batched_jobs"] == report["sweep_jobs"]
 
 
+def test_cachectl_tiny_smoke(capsys):
+    """tools/cachectl.py --tiny: synthetic artifact store -> ls ->
+    verify (clean + after a deliberate corruption) -> gc to a byte
+    budget (ISSUE 13 CI tooling; engine-free, jax-free)."""
+    mod = _load_tool("cachectl")
+    assert mod.main(["--tiny"]) == 0
+    out = capsys.readouterr().out
+    for needle in ("CORRUPT", "gc: kept 2", "cachectl tiny OK"):
+        assert needle in out, f"cachectl output lost {needle!r}:\n{out}"
+
+
+def test_loadgen_cache_tiny_smoke(capsys):
+    """tools/loadgen.py --cache --tiny: 4 identical submits through a
+    real checking service against a self-contained artifact store -
+    1 cold population run, 3 verdict-tier hits asserted to perform
+    ZERO fresh XLA compiles and ZERO engine dispatches, hit p50/p95
+    reported (the ISSUE 13 acceptance instrument)."""
+    mod = _load_tool("loadgen")
+    assert mod.main(["--cache", "--tiny"]) == 0
+    out = capsys.readouterr().out
+    assert "loadgen OK" in out, out
+    report = json.loads(out[: out.index("loadgen OK")])
+    assert report["hit_fresh_xla_compiles"] == 0
+    assert report["hit_engine_dispatches"] == 0
+    assert report["scheduler_cache_hits"] == report["jobs"] - 1
+    assert report["store"]["verdict_hits"] == report["jobs"] - 1
+    assert report["hit_p50_s"] <= report["hit_p95_s"]
+
+
 def test_trace_exporter_tiny_smoke(capsys):
     """The Chrome-trace exporter's --tiny: synthesize a journal, export
     it, and assert the expand/commit lanes landed in the JSON."""

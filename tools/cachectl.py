@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""cachectl: operate the incremental re-checking artifact store.
+
+The content-addressed verdict + reachable-set cache (ISSUE 13,
+jaxtlc/struct/artifacts.py) lives at ``~/.cache/jaxtlc/artifacts`` (or
+``$JAXTLC_ARTIFACT_CACHE``).  This tool is the operator surface:
+
+    python tools/cachectl.py ls                    # list artifacts
+    python tools/cachectl.py verify                # full CRC pass
+    python tools/cachectl.py gc --max-bytes 10e6   # prune LRU to budget
+    python tools/cachectl.py --root DIR ...        # a non-default store
+    python tools/cachectl.py --tiny                # tier-1 smoke
+
+``verify`` re-runs every artifact through the exact checks a cache read
+performs (CRC32, key echo, format/semver) and exits nonzero when any
+fail - the CI guard against bit rot in a long-lived store.  ``gc``
+keeps the newest artifacts that fit the byte budget and deletes the
+rest (reads never delete; pruning is this command's explicit job).
+
+Engine-free and jax-free: safe to run anywhere, including the tier-1
+``--tiny`` smoke, which builds a synthetic store, corrupts one file,
+and asserts ls/verify/gc behave.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+)
+
+import numpy as np  # noqa: E402
+
+from jaxtlc.struct.artifacts import ArtifactStore  # noqa: E402
+
+
+def _store(args) -> ArtifactStore:
+    if args.root:
+        return ArtifactStore(args.root)
+    from jaxtlc.struct.artifacts import get_store
+
+    store = get_store()
+    if store is None:
+        print("cachectl: artifact cache disabled "
+              "(JAXTLC_ARTIFACT_CACHE=off); pass --root DIR",
+              file=sys.stderr)
+        sys.exit(1)
+    return store
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def cmd_ls(store: ArtifactStore, out=sys.stdout) -> int:
+    rows = store.ls()
+    out.write(f"{'tier':8} {'workload':16} {'size':>8} {'age':>8} "
+              "key\n")
+    now = time.time()
+    total = 0
+    for r in rows:
+        total += r["bytes"]
+        age = now - r["mtime"]
+        age_s = (f"{age:.0f}s" if age < 120 else f"{age / 60:.0f}m"
+                 if age < 7200 else f"{age / 3600:.1f}h")
+        out.write(f"{r['tier']:8} {str(r['workload']):16} "
+                  f"{_fmt_bytes(r['bytes']):>8} {age_s:>8} "
+                  f"{r['key'][:16]}...\n")
+    out.write(f"{len(rows)} artifact(s), {_fmt_bytes(total)} total in "
+              f"{store.root}\n")
+    return 0
+
+
+def cmd_verify(store: ArtifactStore, out=sys.stdout) -> int:
+    rows = store.verify()
+    bad = [r for r in rows if not r["ok"]]
+    for r in rows:
+        mark = "ok     " if r["ok"] else "CORRUPT"
+        out.write(f"{mark} {r['tier']:8} {r['key'][:16]}...\n")
+    out.write(f"verified {len(rows)} artifact(s): "
+              f"{len(rows) - len(bad)} ok, {len(bad)} corrupt\n")
+    return 1 if bad else 0
+
+
+def cmd_gc(store: ArtifactStore, max_bytes: float,
+           out=sys.stdout) -> int:
+    res = store.gc(int(max_bytes))
+    out.write(f"gc: kept {res['kept']} artifact(s) "
+              f"({_fmt_bytes(res['bytes'])}), deleted {res['deleted']} "
+              f"(budget {_fmt_bytes(int(max_bytes))})\n")
+    return 0
+
+
+def _tiny() -> int:
+    """Tier-1 smoke: synthetic store -> ls -> verify (clean + after a
+    deliberate corruption) -> gc to a budget.  No engine, no jax."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        store = ArtifactStore(d)
+        for i in range(3):
+            store.put_verdict(f"{'%02x' % i}" + "ab" * 31, dict(
+                workload=f"Tiny{i}", verdict="ok", generated=10 + i,
+                distinct=5 + i, depth=3, queue=0, n_init=1,
+                action_generated={}, action_distinct={},
+                action_order=[], outdegree=None, properties=[],
+                wall_s=0.1, created_t=time.time(),
+            ))
+            time.sleep(0.01)  # distinct mtimes for the LRU order
+        states = np.arange(20, dtype=np.uint32).reshape(10, 2)
+        store.put_reach("ff" * 32, states, dict(
+            workload="TinyR", codec_digest="cd", nbits=40,
+            generated=30, distinct=10, depth=4, n_init=1,
+            action_generated={}, action_distinct={}, outdegree=None,
+        ))
+        rows = store.ls()
+        assert len(rows) == 4, rows
+        assert {r["tier"] for r in rows} == {"verdict", "reach"}
+        assert cmd_verify(store) == 0
+        # round-trip a read through the real lookup path
+        got = store.lookup_reach("ff" * 32)
+        assert got is not None and np.array_equal(got[0], states)
+        # corrupt one verdict artifact in place: verify must flag it,
+        # a lookup must MISS loudly, never answer
+        victim = store._path("verdict", "00" + "ab" * 31)
+        raw = open(victim).read().replace('"generated": 10',
+                                          '"generated": 11')
+        with open(victim, "w") as f:
+            f.write(raw)
+        assert cmd_verify(store) == 1
+        warned = []
+        assert store.lookup_verdict("00" + "ab" * 31,
+                                    warn=warned.append) is None
+        assert warned and "corrupt" in warned[0]
+        # gc to a budget that keeps only the newest artifacts
+        keep = sum(r["bytes"] for r in store.ls()[:2])
+        cmd_gc(store, keep)
+        assert len(store.ls()) == 2
+        s = store.stats()
+        assert s["corrupt"] == 1 and s["writes"] == 4, s
+    print("cachectl tiny OK: ls/verify/corrupt-detect/gc on a "
+          "synthetic store")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cachectl")
+    p.add_argument("cmd", nargs="?",
+                   choices=["ls", "verify", "gc"],
+                   help="ls = list artifacts; verify = full CRC pass "
+                        "(nonzero exit on corruption); gc = prune LRU "
+                        "artifacts to --max-bytes")
+    p.add_argument("--root", default="",
+                   help="store directory (default: the process store "
+                        "per JAXTLC_ARTIFACT_CACHE)")
+    p.add_argument("--max-bytes", type=float, default=64e6,
+                   help="gc byte budget (default 64e6)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (ls/verify)")
+    p.add_argument("--tiny", action="store_true",
+                   help="tier-1 smoke: synthetic store end to end "
+                        "(no engine, no jax)")
+    args = p.parse_args(argv)
+    if args.tiny:
+        return _tiny()
+    if not args.cmd:
+        p.error("command required (ls / verify / gc, or --tiny)")
+    store = _store(args)
+    if args.json:
+        if args.cmd == "ls":
+            print(json.dumps(store.ls(), indent=2))
+            return 0
+        if args.cmd == "verify":
+            rows = store.verify()
+            print(json.dumps(rows, indent=2))
+            return 1 if any(not r["ok"] for r in rows) else 0
+    if args.cmd == "ls":
+        return cmd_ls(store)
+    if args.cmd == "verify":
+        return cmd_verify(store)
+    return cmd_gc(store, args.max_bytes)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

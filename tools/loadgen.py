@@ -133,6 +133,74 @@ def run_load(url: str, jobs: int, sweep_jobs: int,
     return report
 
 
+def run_cache(url: str, jobs: int, in_process: bool,
+              out=sys.stdout) -> dict:
+    """The --cache mode (ISSUE 13): N IDENTICAL submits against the
+    artifact cache.  Submit 1 is the cold population run; submits 2..N
+    must be verdict-tier hits - ZERO fresh XLA compiles (CompileMeter)
+    AND zero engine dispatches (the pool entry's use count freezes) -
+    and their p50/p95 latency is the O(HTTP) number PERF.md round 16
+    compares against the 54 ms warm-pool submit."""
+    from jaxtlc.serve import client
+    from jaxtlc.serve.pool import xla_compiles
+
+    opts = dict(chunk=16, qcap=256, fpcap=1024)
+    t0 = time.time()
+    cold = client.check(url, _SPEC, _CFG, name="cache-cold",
+                        options=opts)
+    cold_s = time.time() - t0
+    assert cold["state"] == "done", cold
+    assert cold["result"]["verdict"] == "ok", cold
+    assert cold["result"]["engine"] == "pool", cold
+
+    def pool_uses():
+        # every pooled dispatch is preceded by exactly one pool lookup
+        # (uses counts hits; the cold build's own run is covered by
+        # the miss/build counters): frozen uses == zero dispatches
+        st = client.pool_stats(url)
+        return (sum(e["uses"] for e in st["pool"]["entries"])
+                + st["pool"]["misses"])
+
+    uses0 = pool_uses()
+    pre = xla_compiles() if in_process else None
+    hit_lat = []
+    for i in range(max(0, jobs - 1)):
+        t0 = time.time()
+        # fine-grained poll (5 ms vs the default 50): the hit path is
+        # O(HTTP), so the default poll interval would BE the number
+        st = client.wait(
+            url,
+            client.submit(url, _SPEC, _CFG, name=f"cache-hit-{i}",
+                          options=opts),
+            poll_s=0.005,
+        )
+        hit_lat.append(time.time() - t0)
+        assert st["state"] == "done", st
+        assert st["result"]["engine"] == "cache", st
+        assert st["result"].get("cache_hit") is True, st
+        assert st["result"]["generated"] == cold["result"]["generated"]
+    fresh = (xla_compiles() - pre) if in_process else 0
+    assert fresh == 0, f"cache-hit path paid {fresh} fresh XLA compiles"
+    dispatches = pool_uses() - uses0
+    assert dispatches == 0, (
+        f"cache-hit path dispatched {dispatches} engine run(s)"
+    )
+    stats = client.pool_stats(url)
+    cache = client._get(url + "/cache")
+    report = dict(
+        jobs=jobs,
+        cold_s=round(cold_s, 4),
+        hit_p50_s=round(_pct(hit_lat, 0.50), 4),
+        hit_p95_s=round(_pct(hit_lat, 0.95), 4),
+        hit_fresh_xla_compiles=fresh,
+        hit_engine_dispatches=dispatches,
+        scheduler_cache_hits=stats["scheduler"]["cache_hits"],
+        store=cache["stats"] if cache.get("enabled") else None,
+    )
+    out.write(json.dumps(report, indent=2, sort_keys=True) + "\n")
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="loadgen")
     p.add_argument("--url", default="",
@@ -142,26 +210,62 @@ def main(argv=None) -> int:
                    help="plain submits of one model (1 cold + N-1 warm)")
     p.add_argument("--sweep-jobs", type=int, default=4,
                    help="sweep submits folded into batched dispatches")
+    p.add_argument("--cache", action="store_true",
+                   help="incremental re-checking mode (ISSUE 13): N "
+                        "identical submits; 1 cold population run, "
+                        "N-1 verdict-tier hits asserted to perform "
+                        "ZERO fresh XLA compiles and ZERO engine "
+                        "dispatches; reports hit p50/p95.  In-process "
+                        "servers get a temp store so the run is "
+                        "self-contained")
     p.add_argument("--tiny", action="store_true",
                    help="tier-1 smoke: in-process server, 4 plain + 4 "
                         "sweep jobs, pool-reuse + zero-compile "
-                        "assertions")
+                        "assertions (with --cache: 4 identical "
+                        "submits through the artifact cache)")
     args = p.parse_args(argv)
     if args.tiny:
         args.jobs, args.sweep_jobs, args.url = 4, 4, ""
 
     srv = None
     url = args.url
-    if not url:
-        from jaxtlc.serve.server import start_server
-
-        srv = start_server(sweep_width=4)
-        url = srv.url
+    token = None
     try:
+        if not url:
+            if args.cache:
+                # self-contained store: the assertions need a cache
+                # that starts empty and nothing else writes to
+                import tempfile
+
+                from jaxtlc.struct import artifacts as arts
+
+                token = arts.configure(
+                    tempfile.mkdtemp(prefix="jaxtlc-loadgen-cache-")
+                )
+            from jaxtlc.serve.server import start_server
+
+            srv = start_server(sweep_width=4)
+            url = srv.url
+        if args.cache:
+            report = run_cache(url, args.jobs, in_process=srv is not None)
+            ok = (report["hit_fresh_xla_compiles"] == 0
+                  and report["hit_engine_dispatches"] == 0
+                  and report["scheduler_cache_hits"] >= args.jobs - 1)
+            print(f"loadgen {'OK' if ok else 'FAILED'}: "
+                  f"{args.jobs} identical submits, 1 cold + "
+                  f"{args.jobs - 1} verdict-tier hits, hit p50 "
+                  f"{report['hit_p50_s'] * 1000:.1f} ms / p95 "
+                  f"{report['hit_p95_s'] * 1000:.1f} ms, 0 fresh "
+                  f"compiles and 0 engine dispatches on the hit path")
+            return 0 if ok else 1
         report = run_load(url, args.jobs, args.sweep_jobs)
     finally:
         if srv is not None:
             srv.shutdown()
+        if token is not None:
+            from jaxtlc.struct import artifacts as arts
+
+            arts.restore(token)
     ok = (report["warm_fresh_xla_compiles"] == 0
           and report["pool"]["hits"] >= args.jobs - 1)
     print(f"loadgen {'OK' if ok else 'FAILED'}: "

@@ -133,6 +133,20 @@ def render(events) -> str:
             f"{sp.get('hits', 0) / probes:.1%} of {sp.get('probes', 0):,}"
             " probes"
         )
+    # incremental re-checking (struct.artifacts): this run's artifact
+    # cache decisions - a hit means the verdict was replayed (or BFS
+    # skipped) instead of re-explored
+    cache_evs = [e for e in events if e["event"] == "cache"]
+    if cache_evs:
+        hits = [e for e in cache_evs if e.get("outcome") == "hit"]
+        misses = sum(1 for e in cache_evs
+                     if e.get("outcome") == "miss")
+        tiers = ",".join(sorted({e["tier"] for e in hits})) or "-"
+        lines.append(
+            f"artifact cache: {len(hits)} hit(s) [{tiers}]  "
+            f"{misses} miss(es)  "
+            f"last {cache_evs[-1]['tier']}/{cache_evs[-1]['outcome']}"
+        )
     # phase attribution (obs.phases): cumulative measured walls per
     # phase - expand/commit from -phase-timing, device/readback free
     # at every fence
