@@ -51,6 +51,12 @@ Usage:
                                # signature AND fpset TABLE words
                                # bit-equality gated (the ISSUE 12
                                # exactness contract)
+    python bench.py --sim      # simulation tier (ISSUE 14): Model_1
+                               # random walks vs the chunk-matched BFS
+                               # engine, both AOT once, interleaved
+                               # best-of-5; emits walks_per_s
+                               # (transitions/s) with vs_baseline =
+                               # sim rate over BFS distinct/s
 """
 
 import json
@@ -949,9 +955,89 @@ def bench_cov_ab(probe_err: str) -> int:
     return 0 if (gate_ok or on_cpu) else 1
 
 
+def bench_sim(probe_err: str) -> int:
+    """--sim: the simulation tier's throughput (ISSUE 14).
+
+    Walks Model_1 with the random-walk engine and runs the chunk-
+    matched exhaustive BFS engine beside it, both AOT-compiled once,
+    timed runs INTERLEAVED best-of-5 (the round-8 methodology): the
+    emitted `walks_per_s` line carries transitions/s (the
+    states-visited rate comparable to states/s) with vs_baseline =
+    sim transitions/s over BFS distinct states/s.  The two tiers
+    answer different questions - BFS proves, simulation samples - so
+    this is a price sheet, not a race."""
+    import jax
+
+    if probe_err:
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from jaxtlc.config import MODEL_1
+    from jaxtlc.engine.backend import kubeapi_backend
+    from jaxtlc.engine.bfs import make_backend_engine
+    from jaxtlc.sim.engine import make_sim_engine, result_from_sim_carry
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    walkers, depth = (512, 128) if on_cpu else (4096, 256)
+    backend = kubeapi_backend(MODEL_1)
+    s_init, s_run, _ = make_sim_engine(
+        backend, walkers=walkers, depth=depth, fp_capacity=1 << 20,
+    )
+    b_init, b_run, _ = make_backend_engine(
+        backend, chunk=1024, queue_capacity=1 << 15,
+        fp_capacity=1 << 20, donate=False,
+    )
+    sim_c0 = jax.jit(s_init)(0)
+    sim_aot = s_run.lower(sim_c0).compile()
+    bfs_c0 = b_init()
+    bfs_aot = b_run.lower(bfs_c0).compile()
+
+    sim_walls, bfs_walls = [], []
+    sim_out = bfs_out = None
+    for _ in range(5):  # interleaved best-of-5, shared AOT (round 8)
+        t0 = time.time()
+        sim_out = jax.block_until_ready(sim_aot(jax.jit(s_init)(0)))
+        sim_walls.append(time.time() - t0)
+        t0 = time.time()
+        bfs_out = jax.block_until_ready(bfs_aot(b_init()))
+        bfs_walls.append(time.time() - t0)
+    sim_wall, bfs_wall = min(sim_walls), min(bfs_walls)
+    r = result_from_sim_carry(sim_out, sim_wall, backend, walkers,
+                              depth, 0)
+    if r.violation or int(bfs_out.viol):
+        _emit({"error": f"unexpected violation (sim {r.violation}, "
+                        f"bfs {int(bfs_out.viol)})", "sim": True})
+        return 1
+    bfs_distinct_per_s = int(bfs_out.distinct) / bfs_wall
+    trans_per_s = r.transitions / sim_wall
+    _emit({
+        "metric": "walks_per_s",
+        "value": round(trans_per_s, 1),
+        "unit": "transitions/s",
+        "vs_baseline": round(trans_per_s / bfs_distinct_per_s, 3),
+        "sim": True,
+        "workload": "Model_1",
+        "walkers": walkers,
+        "depth": depth,
+        "walks_completed_per_s": round(walkers / sim_wall, 1),
+        "transitions": r.transitions,
+        "distinct_sampled": r.distinct,
+        "sim_wall_s": round(sim_wall, 3),
+        "bfs_distinct_per_s": round(bfs_distinct_per_s, 1),
+        "bfs_wall_s": round(bfs_wall, 3),
+        "device": str(jax.devices()[0]) + (
+            f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
+            if probe_err else ""
+        ),
+    })
+    return 0
+
+
 def main() -> int:
     device_note = ""
     probe_err = _probe_backend()
+    if "--sim" in sys.argv:
+        return bench_sim(probe_err)
     if "--commit-ab" in sys.argv:
         return bench_commit_ab(probe_err)
     if "--cov-ab" in sys.argv:

@@ -169,6 +169,31 @@ def _build_sortfree():
                 n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
 
 
+def _build_sim():
+    # the random-walk simulation engine (jaxtlc.sim, ISSUE 14): the
+    # same TwoPhase model as "struct", walked with the counter-based
+    # RNG and the fp sampling filter - the chosen-successor gather,
+    # threefry draw and saturating filter path cannot ship unaudited
+    import os
+
+    from ..sim.engine import make_sim_engine
+    from ..struct.cache import get_backend
+    from ..struct.loader import load
+
+    d = _specs_dir()
+    if d is None:
+        raise FileNotFoundError("specs/ directory not found")
+    model = load(os.path.join(d, "TwoPhase.toolbox", "Model_1",
+                              "MC.cfg"))
+    b = get_backend(model, True)
+    init_fn, run_fn, step_fn = make_sim_engine(
+        b, walkers=8, depth=8, fp_capacity=1 << 10,
+    )
+    return dict(init_fn=lambda: init_fn(0), run_fn=run_fn,
+                step_fn=step_fn, n_lanes=b.n_lanes,
+                fp_capacity=1 << 10)
+
+
 def _build_enumerator():
     from ..engine.bfs import make_enumerator
 
@@ -283,6 +308,7 @@ FACTORIES: Dict[str, Callable[[], dict]] = {
     "phased": _build_phased,
     "pipelined": _build_pipelined,
     "sharded": _build_sharded,
+    "sim": _build_sim,
     "sortfree": _build_sortfree,
     "spill": _build_spill,
     "struct": _build_struct,

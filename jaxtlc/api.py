@@ -91,6 +91,16 @@ class CheckRequest:
     artifactcache: str = ""
     noartifactcache: bool = False
     recheck: bool = False
+    # simulation tier (jaxtlc.sim, ISSUE 14): -simulate swaps the
+    # exhaustive BFS for W vmapped random walks of depth N - the cheap
+    # smoke-check job class.  Every walk lane is a pure function of
+    # (simseed, lane), so violations replay host-side from the seed
+    # alone (sim.replay); a clean sim verdict is a SMOKE verdict and
+    # never publishes to the artifact-cache verdict tier
+    simulate: bool = False
+    depth: int = 100
+    walkers: int = 256
+    simseed: int = 0
     # -- library-only knobs (no CLI flag) -------------------------------
     # MC.cfg-style constant overrides applied on top of the config's
     # baked values (the serve path: a job's constants must shape the
@@ -172,6 +182,14 @@ def _run_check(args) -> int:
         return 1
     from .frontend.model import GenRunSpec, StructRunSpec
 
+    if getattr(args, "simulate", False) and not isinstance(
+            spec, StructRunSpec):
+        # the simulation tier rides the struct frontend (the host
+        # interpreter renders its replayed traces); -frontend struct
+        # runs ANY spec, so this is a spelling, not a capability, gap
+        print("Error: -simulate requires the structural frontend "
+              "(re-run with -frontend struct)", file=_err(args))
+        return 1
     if isinstance(spec, GenRunSpec):
         return _run_check_gen(args, spec)
     if isinstance(spec, StructRunSpec):
@@ -688,6 +706,12 @@ def _resume_command(args) -> str:
         parts += ["-narrow"]  # the narrowed codec is a different layout
     if getattr(args, "coverage", False):
         parts += ["-coverage"]  # the covered carry is a different layout
+    if getattr(args, "simulate", False):
+        # a walk is a pure function of (seed, walkers, depth): the
+        # resume must repeat all three or the cursor meta mismatches
+        parts += ["-simulate", "-depth", str(args.depth),
+                  "-walkers", str(args.walkers),
+                  "-sim-seed", str(args.simseed)]
     if args.frontend != "auto":
         parts += ["-frontend", args.frontend]
     if not args.checkpoint:
@@ -887,6 +911,10 @@ def _run_check_struct(args, spec) -> int:
     if args.recover and not args.checkpoint:
         print("Error: -recover requires -checkpoint PATH", file=_err(args))
         return 1
+    if getattr(args, "simulate", False):
+        # the simulation tier (jaxtlc.sim, ISSUE 14): random-walk
+        # smoke checking instead of exhaustive BFS
+        return _run_sim_struct(args, spec)
     log_holder = []
 
     # -narrow: the certified-bound narrowed codec (analysis.absint).
@@ -1034,6 +1062,188 @@ def _run_check_struct(args, spec) -> int:
     return _run_check_interp(args, spec, kit, log_holder=log_holder)
 
 
+def _run_sim_struct(args, spec) -> int:
+    """The simulation tier (jaxtlc.sim, ISSUE 14): W vmapped random
+    walks of depth N through the struct backend's own kernels, with
+    seed-exact host replay for violations.
+
+    The transcript discipline mirrors the exhaustive struct path - the
+    same banner/journal/preflight plumbing, the same violation message
+    and 2217 trace rendering - but the success message says SMOKE, not
+    "model checking completed": a clean walk proves nothing about
+    unsampled behaviors, which is also why this path journals an
+    artifact-cache BYPASS instead of writing a verdict artifact."""
+    from .resil import EXIT_INTERRUPTED, FaultPlan
+    from .sim.driver import run_sim_supervised
+    from .sim.replay import replay_lane, walk_trace
+    from .struct import artifacts as _arts
+    from .struct import oracle as so
+    from .struct.cache import get_backend
+
+    sm = spec.structmodel
+    unsupported = [
+        flag for flag, on in (
+            ("-sharded", args.sharded),
+            ("-pipeline", args.pipeline),
+            ("-liveness", args.liveness),
+            ("-coverage", args.coverage),
+            ("-narrow", args.narrow),
+            ("-phase-timing", args.phasetiming),
+            ("-mutation", args.mutation),
+            ("-fpset DiskFPSet", args.fpset != "JaxFPSet"),
+        ) if on
+    ]
+    if unsupported:
+        print(
+            f"Error: {', '.join(unsupported)} not supported with "
+            "-simulate (walks carry no frontier/liveness machinery)",
+            file=_err(args),
+        )
+        return 1
+    log = TLCLog(out=args.out, tool_mode=not args.noTool)
+    import jax
+
+    device = str(jax.devices()[0])
+    log.version(__version__)
+    log.banner(spec.fp_index, DEFAULT_SEED, spec.workers, device)
+    log.sany(*_sany_inputs(args.config, spec.spec_name))
+    log.starting()
+    log.computing_init()
+    _open_journal(
+        args, workload=spec.spec_name, engine="sim", device=device,
+        params=dict(walkers=args.walkers, depth=args.depth,
+                    sim_seed=args.simseed, fp_capacity=args.fpcap,
+                    frontend="struct"),
+    )
+    j = getattr(args, "_journal", None)
+    # artifact-cache honesty (ISSUE 14 satellite): when a store is
+    # configured, this run journals an explicit BYPASS - a poisoned
+    # verdict tier would silently answer later EXHAUSTIVE queries with
+    # an incomplete-search verdict
+    if _arts.store_for(args) is not None and j is not None:
+        j.event("cache", tier="verdict", outcome="bypass", key="",
+                reason="simulation verdicts are from incomplete "
+                       "search and never publish")
+    rc = _preflight_gate(
+        args, log, lambda deep: _struct_preflight(args, spec, sm, deep)
+    )
+    if rc is not None:
+        return rc
+    log.msg(1000, f"Running random simulation: {args.walkers} walks "
+                  f"to depth {args.depth} (seed {args.simseed}).")
+    for name in spec.properties:
+        # cfg-declared temporal properties: walks check invariants and
+        # deadlock only (TLC's simulate has the same blind spot)
+        log.msg(1000, f"Temporal property {name} skipped: simulation "
+                      "checks invariants and deadlock on sampled "
+                      "behaviors only.", severity=1)
+    t0 = time.time()
+    resume_cmd = _resume_command(args)
+
+    def on_event(kind, info):
+        if j is not None:
+            ev = j.event(kind, **info)
+        else:
+            from .obs.schema import SCHEMA_VERSION
+
+            ev = {"v": SCHEMA_VERSION, "t": time.time(),
+                  "event": kind, **info}
+        from .obs.views import render_tlc_event
+
+        render_tlc_event(log, ev, resume_cmd=resume_cmd)
+
+    try:
+        sup = run_sim_supervised(
+            sm, seed=args.simseed, walkers=args.walkers,
+            depth=args.depth, fp_capacity=args.fpcap,
+            check_deadlock=spec.check_deadlock,
+            ckpt_path=args.checkpoint or None,
+            ckpt_every=args.checkpointevery, resume=args.recover,
+            faults=(FaultPlan.parse(args.faults) if args.faults
+                    else None),
+            on_event=on_event,
+        )
+    except (FileNotFoundError, ValueError) as e:
+        print(f"Error: {e}", file=_err(args))
+        _finish_journal(args, log)
+        return 1
+    r = sup.result
+    args._result = r
+    log.init_done(len(sm.system.initial_states()))
+    if j is not None:
+        j.event("sim", phase="summary", walkers=r.walkers,
+                depth=r.depth, steps=r.steps,
+                transitions=r.transitions, seed=r.seed,
+                distinct_est=r.distinct,
+                fp_saturated=r.fp_saturated, halted=r.halted,
+                depth_hist=[list(p) for p in r.depth_hist],
+                violation=r.violation)
+    if sup.interrupted:
+        if j is not None:
+            j.event("final", verdict="interrupted",
+                    generated=r.generated, distinct=r.distinct,
+                    depth=r.steps, queue=0,
+                    wall_s=round(time.time() - t0, 6),
+                    interrupted=True)
+        _finish_journal(args, log)
+        return EXIT_INTERRUPTED
+    violated = r.violation != 0
+    if violated:
+        log.msg(2110 if r.violation >= 100 else 1000,
+                r.violation_name, severity=1)
+        # seed-exact replay: the lane's walk IS the counterexample -
+        # re-derived host-side from (seed, lane) alone, decoded through
+        # the struct codec, rendered through the same 2217 path the
+        # BFS trace uses (byte-for-byte transcripts on a forced path)
+        backend = get_backend(sm, spec.check_deadlock)
+        walk = replay_lane(
+            backend, r.seed, r.violation_lane,
+            max(r.violation_step, 0),
+            check_deadlock=spec.check_deadlock,
+        )
+        if j is not None:
+            j.event("sim", phase="replay", walkers=r.walkers,
+                    depth=r.depth, steps=len(walk.fields) - 1,
+                    transitions=len(walk.fields) - 1,
+                    lane=r.violation_lane, seed=r.seed,
+                    violation=walk.violation)
+        if walk.violation != r.violation:
+            log.msg(1000, "Violation was not reproducible in host "
+                          "replay", severity=1)
+        else:
+            for i, (st, act) in enumerate(
+                    walk_trace(walk, backend.cdc), start=1):
+                head = (f"State {i}: <Initial predicate>" if act is None
+                        else f"State {i}: <{act}>")
+                log.msg(2217,
+                        head + "\n" + so.state_to_tla(sm.system, st),
+                        severity=1)
+    else:
+        sat = " (sampling filter saturated: estimate is a floor)" \
+            if r.fp_saturated else ""
+        log.msg(1000, f"Simulation complete: {r.walkers} walks, "
+                      f"{r.transitions} transitions taken to depth "
+                      f"{r.steps}, ~{r.distinct} distinct states "
+                      f"sampled{sat}.")
+        log.msg(1000, "No violation found in the sampled behaviors "
+                      "(simulation is NOT exhaustive - this is a "
+                      "smoke verdict).")
+    log.progress(r.steps, r.generated, r.distinct, 0)
+    log.final_counts(r.generated, r.distinct, 0)
+    log.finished(int((time.time() - t0) * 1000))
+    if j is not None:
+        if violated:
+            j.event("violation", code=int(r.violation),
+                    name=r.violation_name)
+        j.event("final",
+                verdict="violation" if violated else "ok",
+                generated=r.generated, distinct=r.distinct,
+                depth=r.steps, queue=0,
+                wall_s=round(time.time() - t0, 6), interrupted=False)
+    _finish_journal(args, log)
+    return 12 if violated else 0
+
+
 def _artifact_plan(args, spec, sm, bounds):
     """The incremental-re-checking plan for a struct run (ISSUE 13), or
     None when the run is ineligible: resume/fault/mutation runs exist
@@ -1041,7 +1251,12 @@ def _artifact_plan(args, spec, sm, bounds):
     run-shaped artifacts a cached verdict cannot, and -no-artifact-cache
     (or JAXTLC_ARTIFACT_CACHE=off) disables the store outright."""
     if (args.recover or args.faults or args.mutation or args.coverage
-            or args.phasetiming or args.xprof):
+            or args.phasetiming or args.xprof
+            or getattr(args, "simulate", False)):
+        # simulate is unreachable here (the sim path branches off
+        # before plans are built) but stays on the list as defense in
+        # depth: a simulation verdict is from INCOMPLETE search and
+        # must never publish to the verdict tier
         return None
     from .struct import artifacts as _arts
 

@@ -289,6 +289,30 @@ def main(argv=None) -> int:
                         "on -serve, MC.out-format end-of-run dump; the "
                         "KubeAPI path additionally renders the full "
                         "host-walker dump for exact MC.out parity)")
+    c.add_argument("-simulate", action="store_true",
+                   help="randomized simulation instead of exhaustive "
+                        "BFS (jaxtlc.sim, the TLC -simulate analog): "
+                        "-walkers W device-resident random walks of "
+                        "depth -depth N through the same compiled "
+                        "spec kernels, each lane a pure function of "
+                        "(-sim-seed, lane) - a violation replays "
+                        "host-side from the seed alone and renders "
+                        "the standard exit-12 trace.  A clean result "
+                        "is a SMOKE verdict (sampled, not "
+                        "exhaustive); the artifact cache is bypassed. "
+                        " Composes with -checkpoint/-recover (the "
+                        "(seed, step) cursor checkpoints) and "
+                        "-frontend struct runs any spec this way")
+    c.add_argument("-depth", type=int, default=100,
+                   help="simulation walk depth (transitions per "
+                        "walker; TLC's -depth)")
+    c.add_argument("-walkers", type=int, default=256,
+                   help="simulation walker lanes stepped in one "
+                        "vmapped device dispatch")
+    c.add_argument("-sim-seed", dest="simseed", type=int, default=0,
+                   help="simulation run seed: every walk trajectory "
+                        "(and any violation it finds) is an exact "
+                        "pure function of this value")
     c.add_argument("-liveness", action="store_true",
                    help="check the declared temporal properties even when "
                         "the launch config disables them (E8); above "

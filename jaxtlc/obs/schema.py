@@ -116,6 +116,16 @@ EVENTS = {
     # built); on the reach tier it means BFS was skipped and only the
     # invariants were re-evaluated over the stored reachable set
     "cache": {"tier": _STR, "outcome": _STR, "key": _STR},
+    # -- simulation tier (jaxtlc.sim, ISSUE 14) ----------------------------
+    # phase in ("progress", "summary", "replay"): progress rows at the
+    # supervised driver's segment fences, one summary per run (extra
+    # fields: seed, distinct_est, fp_saturated, halted, depth_hist - a
+    # [steps, lanes] histogram of final walk depths), and one replay
+    # row when a violating lane was re-walked host-side (extra fields:
+    # lane, violation).  `steps` is the walk cursor, `transitions` the
+    # cumulative transitions taken across all lanes
+    "sim": {"phase": _STR, "walkers": _NUM, "depth": _NUM,
+            "steps": _NUM, "transitions": _NUM},
     # -- derived artifacts -------------------------------------------------
     "trace_export": {"path": _STR, "events": _NUM},
     # one bench.py metric payload (the BENCH_*.json line contract)

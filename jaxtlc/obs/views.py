@@ -127,6 +127,17 @@ def metrics_from_events(events) -> dict:
                 out["queue_drain_eta_seconds"] = round(eta, 3)
         if "fp_load" in cur:
             out["fp_load"] = cur["fp_load"]
+    sim = next((e for e in reversed(events) if e["event"] == "sim"),
+               None)
+    if sim is not None:
+        # simulation tier (ISSUE 14): walk progress as Prometheus
+        # gauges (jaxtlc_sim_*) - the smoke job class's live surface
+        out["sim_walkers"] = sim["walkers"]
+        out["sim_depth"] = sim["depth"]
+        out["sim_steps"] = sim["steps"]
+        out["sim_transitions"] = sim["transitions"]
+        if "distinct_est" in sim:
+            out["sim_distinct_estimate"] = sim["distinct_est"]
     sp = next((e for e in reversed(events) if e["event"] == "spill"),
               None)
     if sp is not None:
@@ -302,6 +313,10 @@ _BENCH_BASE = {
     # path (False) or the hash-slab sort-free path (True); modes that
     # run both put their setting in explicitly, like "pipeline"
     "sort_free": False,
+    # which search produced the number (ISSUE 14): exhaustive BFS
+    # (False) or the random-walk simulation tier (True - walks/s
+    # payloads, bench.py --sim)
+    "sim": False,
 }
 
 

@@ -275,6 +275,43 @@ class EnginePool:
                  params={c: list(d) for c, d in sorted(params.items())}),
         )
 
+    def get_sim(
+        self,
+        model,
+        params: Optional[Dict[str, Tuple[int, int]]] = None,
+        walkers: int = 64,
+        depth: int = 64,
+        fp_capacity: int = 0,
+        check_deadlock: bool = True,
+    ) -> PoolEntry:
+        """Warm random-walk engine for the smoke job class (jaxtlc.sim,
+        ISSUE 14), keyed like the sweep classes: the SEED is run data
+        (a vmapped batch lane), so one entry serves every per-commit
+        smoke submit of a spec, and `params` (swept constant domains)
+        keys a seeds-x-configs class exactly as sweep.class_key does."""
+        from ..sim.engine import SimEngine, sim_engine_key
+        from .sweep import class_key
+
+        if params:
+            key = ("sim-sweep", class_key(model, params), int(walkers),
+                   int(depth), int(fp_capacity), bool(check_deadlock),
+                   int(self.sweep_width))
+        else:
+            key = sim_engine_key(
+                model, walkers, depth, fp_capacity, check_deadlock
+            ) + (int(self.sweep_width),)
+        return self._get_or_build(
+            key,
+            lambda: SimEngine(
+                model, params=params, walkers=walkers, depth=depth,
+                fp_capacity=fp_capacity, check_deadlock=check_deadlock,
+                width=self.sweep_width,
+            ),
+            "sim",
+            dict(workload=model.root_name, walkers=int(walkers),
+                 depth=int(depth), fp_capacity=int(fp_capacity)),
+        )
+
     # -- prewarm (ISSUE 13 satellite) --------------------------------------
 
     def prewarm(self, specs, chunk: int = None, queue_capacity: int = None,

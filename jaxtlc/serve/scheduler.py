@@ -56,12 +56,19 @@ DEFAULT_CHUNK = 64
 DEFAULT_QCAP = 1 << 10
 DEFAULT_FPCAP = 1 << 12
 
+# the smoke job class's default walk geometry (jaxtlc.sim, ISSUE 14):
+# cheap enough for "check something on every commit in 2 seconds",
+# overridable per job via options walkers/depth
+DEFAULT_SIM_WALKERS = 64
+DEFAULT_SIM_DEPTH = 64
+
 # job options forwarded to api.CheckRequest on the supervised path
 _REQUEST_OPTIONS = (
     "workers", "frontend", "chunk", "qcap", "fpcap", "pipeline",
     "sortfree", "sharded", "checkpoint", "recover", "liveness",
     "fairness", "nodeadlock", "faults", "retry", "maxregrow", "spill",
     "obs", "obsslots", "coverage", "recheck", "noartifactcache",
+    "simulate", "depth", "walkers", "simseed",
 )
 _HEAVY_OPTIONS = ("checkpoint", "recover", "sharded", "liveness",
                   "faults", "coverage")
@@ -113,14 +120,24 @@ class Job:
             return True
         return int(self.options.get("fpcap", 1 << 12)) > large_fpcap
 
+    def is_smoke(self) -> bool:
+        """The simulation job class (options.simulate): random walks
+        through the warm sim engine - the cheap per-commit check."""
+        return bool(self.options.get("simulate"))
+
     def batch_signature(self) -> str:
-        """Jobs with equal signatures fold into one sweep dispatch:
+        """Jobs with equal signatures fold into one vmapped dispatch:
         identical spec/cfg/options/sweep, constants equal OUTSIDE the
-        swept names (inside them is the batch axis)."""
+        swept names (inside them is the batch axis).  Smoke jobs
+        additionally drop `simseed` from the compared options - the
+        seed is a batch lane, so one warm sim engine serves seeds x
+        configs in one dispatch (ISSUE 14)."""
         fixed = {k: v for k, v in sorted(self.constants.items())
                  if k not in self.sweep_params()}
+        opts = {k: v for k, v in self.options.items()
+                if not (self.is_smoke() and k == "simseed")}
         blob = json.dumps(
-            [self.spec, self.cfg, sorted(self.options.items()),
+            [self.spec, self.cfg, sorted(opts.items()),
              sorted((self.sweep or {}).items()), fixed],
             sort_keys=True,
         )
@@ -259,7 +276,8 @@ class Scheduler:
                     return
                 head = self.jobs[self._queue.popleft()]
                 batch = [head]
-                if head.sweep and not head.is_large(self.large_fpcap):
+                if (head.sweep or head.is_smoke()) \
+                        and not head.is_large(self.large_fpcap):
                     # look ahead: fold queued jobs of the same class
                     # into this dispatch (FIFO among the folded; the
                     # skipped-over rest keeps its order)
@@ -305,6 +323,9 @@ class Scheduler:
 
     def _run_batch(self, batch: List[Job]) -> None:
         head = batch[0]
+        if head.is_smoke() and not head.is_large(self.large_fpcap):
+            self._run_smoke(batch)
+            return
         if head.sweep and not head.is_large(self.large_fpcap):
             self._run_sweep(batch)
             return
@@ -379,6 +400,115 @@ class Scheduler:
                      wall_s=round(r.wall_s, 6), interrupted=False)
             jr.close()
             self._finish_ok(j, _result_dict(r, "sweep", pool_hit=hit))
+
+    def _run_smoke(self, batch: List[Job]) -> None:
+        """The smoke job class (jaxtlc.sim, ISSUE 14): one vmapped
+        random-walk dispatch for the whole compatible batch - the
+        batch axis is (seed, swept-constants config), so N per-commit
+        smoke submits (different seeds) and a constants sweep both
+        ride ONE warm sim engine.  The artifact cache is BYPASSED
+        (journaled per job): simulation verdicts are from incomplete
+        search and must never publish to the verdict tier."""
+        import jax
+
+        from ..struct import artifacts as arts
+        from ..struct.loader import StructLoadError, load
+        from ..struct.parser import StructParseError
+        from . import sweep as sw
+
+        head = batch[0]
+        params = head.sweep_params() or None
+        cfg_path = self._jobdir(head)
+        fixed = _loader_constants({
+            k: v for k, v in head.constants.items()
+            if k not in (params or {})
+        })
+        try:
+            if params:
+                model = sw.load_anchored(cfg_path, params,
+                                         const_overrides=fixed or None)
+            else:
+                model = load(cfg_path, const_overrides=fixed or None)
+        except (StructLoadError, StructParseError):
+            # the sim engine is struct-only today: route through
+            # api.run_check with the frontend forced struct (it runs
+            # any spec) so the job still gets a real answer or a
+            # real error
+            for j in batch:
+                self._run_supervised(j, frontend="struct")
+            return
+        o = head.options
+        walkers = int(o.get("walkers", DEFAULT_SIM_WALKERS))
+        depth = int(o.get("depth", DEFAULT_SIM_DEPTH))
+        fp_capacity = int(o.get("fpcap", DEFAULT_FPCAP))
+        check_deadlock = not o.get("nodeadlock", False)
+        pre = self.pool.hits
+        entry = self.pool.get_sim(
+            model, params=params, walkers=walkers, depth=depth,
+            fp_capacity=fp_capacity, check_deadlock=check_deadlock,
+        )
+        hit = self.pool.hits > pre
+        items = [
+            (int(j.options.get("simseed", 0)),
+             ({c: int(j.constants[c]) for c in params}
+              if params else None))
+            for j in batch
+        ]
+        bypass = (arts.get_store() is not None
+                  and not o.get("noartifactcache"))
+        device = str(jax.devices()[0])
+        journals = []
+        for j, (seed, values) in zip(batch, items):
+            if j is not head:
+                self._jobdir(j)
+            jr = self._journal(j)
+            jr.event("run_start", version=_version(), workload=j.name,
+                     engine="sim", device=device,
+                     params=dict(walkers=walkers, depth=depth,
+                                 sim_seed=seed, fp_capacity=fp_capacity,
+                                 sweep=j.sweep, constants=j.constants,
+                                 batch=len(batch), pool_hit=hit))
+            if bypass:
+                jr.event("cache", tier="verdict", outcome="bypass",
+                         key="", reason="simulation verdicts are from "
+                                        "incomplete search and never "
+                                        "publish")
+            journals.append(jr)
+        try:
+            results = entry.runner.run(items)
+        except BaseException:
+            self._abort_journals(journals)
+            raise
+        with self._cond:
+            self.batches_run += 1
+            self.batched_jobs += len(batch)
+        for j, jr, r in zip(batch, journals, results):
+            jr.event("sim", phase="summary", walkers=r.walkers,
+                     depth=r.depth, steps=r.steps,
+                     transitions=r.transitions, seed=r.seed,
+                     distinct_est=r.distinct,
+                     fp_saturated=r.fp_saturated, halted=r.halted,
+                     depth_hist=[list(p) for p in r.depth_hist],
+                     violation=r.violation)
+            if r.violation != 0:
+                jr.event("violation", code=int(r.violation),
+                         name=r.violation_name)
+            jr.event("final",
+                     verdict="ok" if r.violation == 0 else "violation",
+                     generated=r.generated, distinct=r.distinct,
+                     depth=r.steps, queue=0,
+                     wall_s=round(r.wall_s, 6), interrupted=False)
+            jr.close()
+            res = _result_dict(r, "sim", pool_hit=hit)
+            res["depth"] = r.steps  # depth REACHED (r.depth = budget)
+            res["sim"] = dict(
+                walkers=r.walkers, depth=r.depth, steps=r.steps,
+                transitions=r.transitions, seed=r.seed,
+                distinct_est=r.distinct, fp_saturated=r.fp_saturated,
+                violation_lane=r.violation_lane,
+                violation_step=r.violation_step,
+            )
+            self._finish_ok(j, res)
 
     def _run_pooled(self, job: Job) -> None:
         """Warm plain engine via the pool; falls back to the supervised

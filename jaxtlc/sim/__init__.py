@@ -1,0 +1,39 @@
+"""Simulation tier: vmapped random-walk smoke checking (ISSUE 14).
+
+TLC ships a randomized simulation mode next to its exhaustive engine
+(the TLA+ Trifecta survey, PAPERS.md) because exhaustive BFS caps the
+reachable workload set: configs whose state spaces do not fit a table
+still yield real assurance from many deep random walks.  This package
+is that mode, TPU-shaped: W walker lanes, each holding ONE packed
+state, stepped depth-D through the SAME SpecBackend expand/invariant
+kernels every exhaustive engine uses (engine.backend - no second
+compiler path), choosing a uniformly random enabled successor per step
+with counter-based threefry bits so every lane is a pure function of
+``(run_seed, lane_id)``.
+
+That purity is the whole design: a tripped invariant / deadlock /
+assertion lane needs NO on-device trace storage - ``sim.replay``
+re-walks the lane host-side from its seed, reproduces the identical
+trajectory bit-for-bit, and the violation renders as the same
+PlusCal-level exit-12 trace a BFS run would print.
+
+Zero cross-lane communication makes the walk embarrassingly
+vmappable: ``SimEngine`` batches (seed, constants-config) lanes the
+way serve.sweep batches constant configs - swept CONSTANTs ride as
+state fields, so seeds x configs check in one device dispatch.
+
+A simulation verdict is a SMOKE verdict: "ok" means no violation was
+found in the sampled behaviors, never that none exists.  The artifact
+cache (struct.artifacts) is bypassed on this path - an incomplete
+search must not publish into the exhaustive verdict tier.
+"""
+
+from .engine import (  # noqa: F401
+    SimCarry,
+    SimEngine,
+    SimResult,
+    get_sim_engine,
+    make_sim_engine,
+    result_from_sim_carry,
+)
+from .replay import replay_lane  # noqa: F401

@@ -1,0 +1,226 @@
+"""Supervised simulation runs: the (seed, step) cursor as a run unit.
+
+The walk carry is a fixed-shape pytree whose ``step_i`` IS the resume
+cursor (every lane is a pure function of ``(seed, lane)``, so a carry
+at step k plus the run seed determines the remainder of the run
+exactly).  This driver applies the resil conventions to it: segments
+of ``ckpt_every`` transition rounds, a SIGTERM/SIGINT drain that
+writes a final generation and reports ``interrupted`` (the CLI's
+exit-75 / -recover contract), CRC-manifested generation-numbered
+checkpoints through engine.checkpoint's generic pytree snapshots, and
+deterministic fault injection (resil.faults ``sigterm@K`` fires at
+segment K) so the recovery path is proven in tier-1, not believed.
+
+There is no degradation ladder here on purpose: the walk allocates
+nothing that grows (the optional fp sampling filter SATURATES instead
+of halting), so the only recoveries a smoke run needs are preemption
+and resume.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import NamedTuple, Optional
+
+import jax
+from jax import lax
+
+from ..engine.checkpoint import (
+    load_latest_generation,
+    save_generation,
+)
+from ..resil.faults import FaultInjector, FaultPlan
+from ..resil.supervisor import _SignalCatcher
+from .engine import (
+    SimResult,
+    get_sim_engine,
+    result_from_sim_carry,
+    sim_done,
+    sim_engine_key,
+)
+
+SIM_FORMAT = 1
+
+_SEG_MEMO = None  # compiled segment executables (struct.cache._LRUMemo)
+
+
+def _compiled_segment(model, walkers, depth, fp_capacity,
+                      check_deadlock, ckpt_every, step_fn, template):
+    """AOT segment executable, memoized on (engine key, cadence): the
+    template's shapes are seed-independent, so one compile serves every
+    run of a model - an api -simulate resubmit performs zero fresh XLA
+    compiles (the pool discipline applied to the supervised path)."""
+    from ..struct.cache import _LRUMemo
+
+    global _SEG_MEMO
+    if _SEG_MEMO is None:
+        _SEG_MEMO = _LRUMemo(8)
+    key = sim_engine_key(model, walkers, depth, fp_capacity,
+                         check_deadlock) + (int(ckpt_every),)
+    hit = _SEG_MEMO.get(key)
+    if hit is None:
+        @jax.jit
+        def segment(c):
+            return lax.fori_loop(0, ckpt_every,
+                                 lambda _, cc: step_fn(cc), c)
+
+        hit = segment.lower(template).compile()
+        _SEG_MEMO.put(key, hit)
+    return hit
+
+
+class SimSupervised(NamedTuple):
+    result: SimResult
+    interrupted: bool
+    segments: int
+    ckpt_writes: int
+
+
+def sim_meta(model, seed: int, walkers: int, depth: int,
+             fp_capacity: int, check_deadlock: bool) -> dict:
+    """The checkpoint meta stanza: spec meaning + the FULL walk
+    identity, seed included - a -recover against a different seed (or
+    walk geometry) is a different trajectory and must mismatch loudly,
+    never silently splice two runs."""
+    from ..struct.backend import struct_meta_config
+
+    return json.loads(json.dumps({
+        "format": SIM_FORMAT,
+        "kind": "sim",
+        "config": struct_meta_config(model),
+        "seed": int(seed),
+        "walkers": int(walkers),
+        "depth": int(depth),
+        "fp_capacity": int(fp_capacity),
+        "check_deadlock": bool(check_deadlock),
+    }))
+
+
+def _emit(on_event, kind: str, **info):
+    if on_event is not None:
+        on_event(kind, info)
+
+
+def _progress_info(carry, walkers: int, depth: int, seed: int) -> dict:
+    return dict(
+        phase="progress", walkers=int(walkers), depth=int(depth),
+        steps=int(carry.step_i), transitions=int(carry.transitions),
+        seed=int(seed),
+        distinct_est=(int(carry.distinct)
+                      if carry.distinct is not None else 0),
+    )
+
+
+def run_sim(model, seed: int = 0, walkers: int = 256, depth: int = 100,
+            fp_capacity: int = 0, check_deadlock: bool = True
+            ) -> SimResult:
+    """One unsupervised walk run: AOT-compile the fused while_loop,
+    dispatch once, time execution only (the bfs.check discipline)."""
+    backend, init_fn, run_fn, _ = get_sim_engine(
+        model, walkers, depth, fp_capacity=fp_capacity,
+        check_deadlock=check_deadlock,
+    )
+    carry = jax.jit(init_fn)(seed)
+    compiled = run_fn.lower(carry).compile()
+    t0 = time.time()
+    out = jax.block_until_ready(compiled(carry))
+    wall = time.time() - t0
+    return result_from_sim_carry(out, wall, backend, walkers, depth,
+                                 seed)
+
+
+def run_sim_supervised(
+    model,
+    seed: int = 0,
+    walkers: int = 256,
+    depth: int = 100,
+    fp_capacity: int = 0,
+    check_deadlock: bool = True,
+    ckpt_path: Optional[str] = None,
+    ckpt_every: int = 64,
+    resume: bool = False,
+    faults: Optional[FaultPlan] = None,
+    on_event=None,
+) -> SimSupervised:
+    """Segmented walk run with preemption safety and cursor resume.
+
+    `on_event(kind, info)` receives schema-shaped journal events:
+    ``sim`` progress rows at every segment fence, ``checkpoint`` /
+    ``recovery`` / ``interrupted`` with the resil meanings.  A resumed
+    run continues from the checkpointed (seed, step) cursor and its
+    final carry is bit-for-bit the uninterrupted run's
+    (tests/test_sim.py pins this through a sigterm@K fault)."""
+    backend, init_fn, _, step_fn = get_sim_engine(
+        model, walkers, depth, fp_capacity=fp_capacity,
+        check_deadlock=check_deadlock,
+    )
+    meta = sim_meta(model, seed, walkers, depth, fp_capacity,
+                    check_deadlock)
+    template = jax.jit(init_fn)(seed)
+    compiled = _compiled_segment(
+        model, walkers, depth, fp_capacity, check_deadlock,
+        ckpt_every, step_fn, template,
+    )
+    carry = template
+    if resume:
+        if not ckpt_path:
+            raise FileNotFoundError("-recover needs a sim -checkpoint")
+        path, saved_meta, carry = load_latest_generation(
+            ckpt_path, template
+        )
+        for key, want in meta.items():
+            got = saved_meta.get(key)
+            if got != want:
+                raise ValueError(
+                    f"sim checkpoint {key} mismatch: {got!r} != "
+                    f"{want!r} (a walk is a pure function of its seed "
+                    "and geometry - resumes cannot cross them)"
+                )
+        _emit(on_event, "recovery", path=path,
+              depth=int(carry.step_i), generated=int(carry.generated),
+              distinct=(int(carry.distinct)
+                        if carry.distinct is not None else 0),
+              queue=0)
+
+    injector = FaultInjector(faults)
+    t0 = time.time()
+    segments = 0
+    ckpt_writes = 0
+    interrupted = False
+    with _SignalCatcher() as sig:
+        while not sim_done(carry, depth):
+            injector.segment_start(segments)
+            if sig.hit is not None:
+                interrupted = True
+                break
+            carry = jax.block_until_ready(compiled(carry))
+            segments += 1
+            _emit(on_event, "sim",
+                  **_progress_info(carry, walkers, depth, seed))
+            if ckpt_path and not sim_done(carry, depth):
+                tck = time.time()
+                path = save_generation(ckpt_path, carry, meta)
+                ckpt_writes += 1
+                _emit(on_event, "checkpoint", path=path,
+                      seconds=round(time.time() - tck, 6), label="sim")
+            if sig.hit is not None:
+                interrupted = True
+                break
+        if sig.hit is not None and not sim_done(carry, depth):
+            interrupted = True
+    wall = time.time() - t0
+    if interrupted:
+        path = None
+        if ckpt_path:
+            path = save_generation(ckpt_path, carry, meta)
+            ckpt_writes += 1
+        _emit(on_event, "interrupted", signum=int(sig.hit or 0),
+              path=path, generated=int(carry.generated),
+              distinct=(int(carry.distinct)
+                        if carry.distinct is not None else 0),
+              queue=0, wall_s=round(wall, 6))
+    result = result_from_sim_carry(carry, wall, backend, walkers,
+                                   depth, seed)
+    return SimSupervised(result=result, interrupted=interrupted,
+                         segments=segments, ckpt_writes=ckpt_writes)

@@ -30,6 +30,34 @@ class StructActionError(ValueError):
     pass
 
 
+def expand_unchanged(names, defs, variables) -> List[str]:
+    """UNCHANGED accepts state variables AND tuple-of-variables
+    definitions (the universal `vars == <<...>>` convention TLC
+    honors: `UNCHANGED vars`): expand definition names into the
+    variables they bundle, recursively.  Names that are neither a
+    variable nor such a definition pass through unchanged so the
+    caller's own unknown-variable error still fires."""
+    out: List[str] = []
+    for v in names:
+        if v in variables:
+            out.append(v)
+            continue
+        d = defs.get(v)
+        body = getattr(d, "body", None)
+        if body is not None and body[0] == "tuple" and all(
+            x[0] == "name" for x in body[1]
+        ):
+            out.extend(expand_unchanged(
+                [x[1] for x in body[1]], defs, variables
+            ))
+            continue
+        if body is not None and body[0] == "name":
+            out.extend(expand_unchanged([body[1]], defs, variables))
+            continue
+        out.append(v)
+    return out
+
+
 class ActionSystem:
     """Enumerates initial states and successors of a parsed module."""
 
@@ -216,7 +244,8 @@ class ActionSystem:
             # falls through to guard evaluation
         if op == "unchanged":
             p2 = dict(primed)
-            for v in ast[1]:
+            for v in expand_unchanged(ast[1], self.ev.defs,
+                                      self.variables):
                 old = env.get(v)
                 if v not in env:
                     raise StructActionError(f"UNCHANGED unknown var {v}")

@@ -1984,8 +1984,11 @@ class LaneCompiler:
                 self._walk_seq([d.body] + rest, 0, env2, ctx, inner, out)
                 return
         if op == "unchanged":
+            from .actions import expand_unchanged
+
             env2 = dict(env)
-            for v in ast[1]:
+            for v in expand_unchanged(ast[1], self.ev.defs,
+                                      set(self.variables)):
                 env2[("'", v)] = "passthrough"
             if self._cov_on():
                 ctx.cov_effects.append(self.cov.site(

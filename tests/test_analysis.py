@@ -542,7 +542,8 @@ def test_selfcheck_registry_pinned():
 
     assert sorted(FACTORIES) == [
         "covered", "enumerator", "fused", "narrowed", "phased",
-        "pipelined", "sharded", "sortfree", "spill", "struct", "sweep",
+        "pipelined", "sharded", "sim", "sortfree", "spill", "struct",
+        "sweep",
     ]
 
 
@@ -559,7 +560,7 @@ def test_selfcheck_tiny_smoke():
     out = buf.getvalue()
     assert rc == 0, out
     for name in ("fused", "pipelined", "sharded", "spill", "struct",
-                 "narrowed", "enumerator"):
+                 "narrowed", "enumerator", "sim"):
         assert f"audit {name}: ok" in out, out
 
 

@@ -237,6 +237,63 @@ def test_warm_resubmit_zero_fresh_xla_compiles(server, sweep_jobs):
 
 
 # ---------------------------------------------------------------------------
+# smoke job class: sim submits fold, reuse the warm engine (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+_SIM_OPTS = dict(simulate=True, walkers=8, depth=12, fpcap=1 << 10,
+                 nodeadlock=True)
+
+
+def test_smoke_job_class_e2e(server, sweep_jobs):
+    """The simulation job class end to end on the SHARED CheckServer:
+    two smoke submits with different seeds fold into one vmapped
+    dispatch through one warm sim engine (the seed is a batch lane,
+    not key material), journal schema-v1 `sim` events, and a warm
+    resubmit performs ZERO fresh XLA compiles."""
+    from jaxtlc.serve.pool import xla_compiles
+
+    pre_batches = client.pool_stats(server.url)["scheduler"]
+    ids = {s: client.submit(server.url, _TPB, _cfg(2),
+                            name=f"smoke-{s}",
+                            options=dict(_SIM_OPTS, simseed=s))
+           for s in (1, 2)}
+    sts = {s: client.wait(server.url, i, timeout=600)
+           for s, i in ids.items()}
+    for s, st in sts.items():
+        assert st["state"] == "done", st
+        r = st["result"]
+        assert r["engine"] == "sim" and r["verdict"] == "ok", r
+        assert r["sim"]["seed"] == s
+        assert r["sim"]["walkers"] == 8
+        assert r["sim"]["transitions"] > 0
+    # different seeds diverge (the TwoPhaseB walk space branches)
+    assert (sts[1]["result"]["action_generated"]
+            != sts[2]["result"]["action_generated"]
+            or sts[1]["result"]["sim"]["distinct_est"]
+            != sts[2]["result"]["sim"]["distinct_est"])
+    post = client.pool_stats(server.url)["scheduler"]
+    assert post["batched_jobs"] - pre_batches["batched_jobs"] == 2
+
+    # the journal is a complete schema-valid run with a sim summary
+    events = list(client.stream(server.url, sts[1]["id"]))
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "final"
+    assert events[0]["engine"] == "sim"
+    sim_evs = [e for e in events if e["event"] == "sim"]
+    assert sim_evs and sim_evs[-1]["phase"] == "summary"
+    assert events[-1]["verdict"] == "ok"
+
+    # warm resubmit of a THIRD seed: pool hit, zero fresh XLA compiles
+    pre = xla_compiles()
+    st = client.check(server.url, _TPB, _cfg(2), name="smoke-warm",
+                      options=dict(_SIM_OPTS, simseed=3))
+    assert st["result"]["engine"] == "sim"
+    assert st["result"]["pool_hit"] is True
+    assert xla_compiles() - pre == 0, "warm smoke submit recompiled"
+
+
+# ---------------------------------------------------------------------------
 # sweep parity: vmapped == sequential, bit for bit
 # ---------------------------------------------------------------------------
 

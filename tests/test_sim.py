@@ -1,0 +1,387 @@
+"""Simulation tier tests (ISSUE 14).
+
+Budget discipline (tier-1 runs ~800 s of its 870 s ceiling): ONE
+module-scoped sim-engine fixture owns the primary walk compile; the
+replay / parity / supervised tests all reuse it (the SimEngine and the
+supervised segment add two tiny same-model compiles, and the
+violation/deadlock specs are 1-variable 1-lane modules whose compiles
+are seconds).  Pinned here:
+
+* seed determinism: same seed => bit-identical final carries, lane
+  trajectories included; a different seed diverges;
+* seed-exact replay: the host re-walk of (seed, lane) reproduces the
+  device lane's final state and step count bit-for-bit;
+* violation replay: a seeded invariant violation found by simulation
+  renders the IDENTICAL exit-12 trace (byte-for-byte State blocks) as
+  the full BFS run - replayed from (seed, lane) alone;
+* deadlock detection + replay of the deadlocked walk;
+* sweep-lane parity: the vmapped seed batch equals sequential
+  single-seed runs of the same compiled walk, result-for-result;
+* SIGTERM -> -recover cursor continuity: the resumed walk's final
+  result equals the uninterrupted run's exactly;
+* artifact-cache honesty: a clean sim run journals a BYPASS and
+  writes NO artifact (a poisoned verdict tier would answer later
+  exhaustive queries with an incomplete-search verdict).
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+_SIM_TINY = """---- MODULE SimTiny ----
+EXTENDS Naturals
+CONSTANTS MAX
+VARIABLES x, y
+
+Init == /\\ x = 0
+        /\\ y = 0
+
+Up == /\\ x < MAX
+      /\\ x' = x + 1
+      /\\ y' = y
+
+Down == /\\ x > 0
+        /\\ x' = x - 1
+        /\\ y' = y
+
+Flip == /\\ x > 0
+        /\\ y' = 1 - y
+        /\\ x' = x
+
+Next == Up \\/ Down \\/ Flip
+
+Spec == Init /\\ [][Next]_<<x, y>>
+
+InRange == x <= MAX
+====
+"""
+_SIM_TINY_CFG = ("CONSTANT MAX = 4\nSPECIFICATION\nSpec\n"
+                 "INVARIANT\nInRange\n")
+
+# the seeded-violation module: a FORCED single path (one enabled
+# action from Init whose successor violates), so the random walk's
+# prefix IS the BFS shortest trace and the two transcripts must match
+# byte for byte
+_SIM_VIOL = """---- MODULE SimViol ----
+EXTENDS Naturals
+VARIABLES x
+
+Init == x = 0
+
+Step == /\\ x < 3
+        /\\ x' = x + 1
+
+Next == Step
+
+Spec == Init /\\ [][Next]_x
+
+NotOne == x # 1
+====
+"""
+_SIM_VIOL_CFG = "SPECIFICATION\nSpec\nINVARIANT\nNotOne\n"
+
+# the deadlock module: x walks 0 -> 3 and stops (no successor at 3)
+_SIM_DEAD = """---- MODULE SimDead ----
+EXTENDS Naturals
+VARIABLES x
+
+Init == x = 0
+
+Step == /\\ x < 3
+        /\\ x' = x + 1
+
+Next == Step
+
+Spec == Init /\\ [][Next]_x
+====
+"""
+_SIM_DEAD_CFG = "SPECIFICATION\nSpec\n"
+
+_WALKERS, _DEPTH, _FPCAP = 8, 16, 1 << 10
+
+
+def _write_model(d, name, spec, cfg) -> str:
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"{name}.tla"), "w") as f:
+        f.write(spec)
+    path = os.path.join(d, f"{name}.cfg")
+    with open(path, "w") as f:
+        f.write(cfg)
+    return path
+
+
+@pytest.fixture(scope="module")
+def simkit(tmp_path_factory):
+    """THE module sim engine: one walk compile every test here reuses
+    (deadlock-free model so walks always run to depth)."""
+    import jax
+
+    from jaxtlc.sim.engine import get_sim_engine
+    from jaxtlc.struct.loader import load
+
+    d = str(tmp_path_factory.mktemp("simtiny"))
+    cfg = _write_model(d, "SimTiny", _SIM_TINY, _SIM_TINY_CFG)
+    model = load(cfg)
+    backend, init_fn, run_fn, step_fn = get_sim_engine(
+        model, _WALKERS, _DEPTH, fp_capacity=_FPCAP,
+        check_deadlock=False,
+    )
+    init_jit = jax.jit(init_fn)
+
+    def run(seed):
+        return jax.block_until_ready(run_fn(init_jit(seed)))
+
+    return dict(dir=d, cfg=cfg, model=model, backend=backend,
+                init_fn=init_fn, run_fn=run_fn, step_fn=step_fn,
+                run=run)
+
+
+def _leaves_equal(a, b) -> bool:
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+def _same_result(a, b) -> bool:
+    """SimResult equality modulo wall time (violation_state is an
+    array, so NamedTuple == is unusable directly)."""
+    a = a._replace(wall_s=0.0)
+    b = b._replace(wall_s=0.0)
+    return all(
+        np.array_equal(x, y) if isinstance(x, np.ndarray) else x == y
+        for x, y in zip(a, b)
+    )
+
+
+# ---------------------------------------------------------------------------
+# seed determinism + seed-exact replay
+# ---------------------------------------------------------------------------
+
+
+def test_same_seed_bit_identical_trajectories(simkit):
+    out1 = simkit["run"](7)
+    out2 = simkit["run"](7)
+    assert _leaves_equal(out1, out2)
+    assert int(out1.step_i) == _DEPTH and bool(
+        np.asarray(out1.alive).all()
+    )
+
+
+def test_different_seed_diverges(simkit):
+    out7 = simkit["run"](7)
+    out8 = simkit["run"](8)
+    assert not np.array_equal(np.asarray(out7.states),
+                              np.asarray(out8.states))
+
+
+def test_replay_reproduces_device_lanes(simkit):
+    """The host re-walk of (seed, lane) lands on the device lane's
+    exact final state - the property that makes violation reporting
+    exact with zero on-device trace storage."""
+    from jaxtlc.sim.replay import replay_lane
+
+    out = simkit["run"](7)
+    for lane in range(_WALKERS):
+        walk = replay_lane(simkit["backend"], 7, lane, _DEPTH,
+                           check_deadlock=False)
+        assert np.array_equal(walk.fields[-1],
+                              np.asarray(out.states)[lane]), lane
+        assert len(walk.fields) - 1 == int(
+            np.asarray(out.steps_taken)[lane]
+        )
+
+
+# ---------------------------------------------------------------------------
+# violation: replayed trace == the BFS-found trace, byte for byte
+# ---------------------------------------------------------------------------
+
+
+def _trace_block(text: str) -> str:
+    return "\n".join(
+        ln for ln in text.splitlines()
+        if ln.startswith(("State ", "/\\"))
+    )
+
+
+def test_seeded_violation_trace_identical_to_bfs(tmp_path):
+    """-simulate finds the seeded invariant violation and renders the
+    IDENTICAL exit-12 trace (byte-for-byte State blocks) as the full
+    exhaustive BFS run of the same model - reconstructed host-side
+    from (seed, lane) alone (sim.replay), while BFS reconstructs via
+    the host-interpreter parent chain.  Two independent mechanisms,
+    one transcript."""
+    from jaxtlc.api import CheckRequest, run_check
+
+    cfg = _write_model(str(tmp_path / "v"), "SimViol", _SIM_VIOL,
+                       _SIM_VIOL_CFG)
+    out_sim = io.StringIO()
+    oc = run_check(CheckRequest(
+        config=cfg, workers="cpu", frontend="struct", simulate=True,
+        walkers=4, depth=8, simseed=5, fpcap=_FPCAP, nodeadlock=True,
+        noTool=True, out=out_sim, err=out_sim,
+        journal=str(tmp_path / "sim.journal.jsonl"),
+    ))
+    assert oc.exit_code == 12 and oc.verdict == "violation"
+    r = oc.result
+    assert r.violation_step == 1  # the forced first transition
+    out_bfs = io.StringIO()
+    oc2 = run_check(CheckRequest(
+        config=cfg, workers="cpu", frontend="struct", chunk=16,
+        qcap=256, fpcap=_FPCAP, nodeadlock=True, obs=False,
+        autogrow=False, noTool=True, out=out_bfs, err=out_bfs,
+    ))
+    assert oc2.exit_code == 12
+    sim_trace = _trace_block(out_sim.getvalue())
+    bfs_trace = _trace_block(out_bfs.getvalue())
+    assert sim_trace and sim_trace == bfs_trace
+    assert "Invariant NotOne is violated" in out_sim.getvalue()
+    # the journal records the run as engine "sim" with a replay event
+    from jaxtlc.obs import journal as jr
+
+    events = jr.read(str(tmp_path / "sim.journal.jsonl"))
+    kinds = [e["event"] for e in events]
+    assert events[0]["engine"] == "sim"
+    assert "sim" in kinds and "violation" in kinds
+    replay = [e for e in events if e["event"] == "sim"
+              and e["phase"] == "replay"]
+    assert replay and replay[0]["lane"] == r.violation_lane
+    assert events[-1]["event"] == "final"
+    assert events[-1]["verdict"] == "violation"
+
+
+def test_deadlock_detection_and_replay(tmp_path):
+    """A walker that runs out of successors trips VIOL_DEADLOCK, and
+    the (seed, lane) replay re-walks to the deadlocked state."""
+    from jaxtlc.engine.bfs import VIOL_DEADLOCK
+    from jaxtlc.sim.driver import run_sim
+    from jaxtlc.sim.replay import replay_lane, walk_trace
+    from jaxtlc.struct.loader import load
+
+    cfg = _write_model(str(tmp_path / "d"), "SimDead", _SIM_DEAD,
+                       _SIM_DEAD_CFG)
+    model = load(cfg)
+    r = run_sim(model, seed=1, walkers=4, depth=8,
+                check_deadlock=True)
+    assert r.violation == VIOL_DEADLOCK
+    assert r.violation_step == 4  # x: 0 -> 1 -> 2 -> 3, stuck at 3
+    from jaxtlc.struct.cache import get_backend
+
+    backend = get_backend(model, True)
+    walk = replay_lane(backend, 1, r.violation_lane, r.violation_step)
+    assert walk.violation == VIOL_DEADLOCK
+    trace = walk_trace(walk, backend.cdc)
+    assert trace[0] == ((0,), None)
+    assert trace[-1][0] == (3,)
+    assert [lbl for _st, lbl in trace[1:]] == ["Step"] * 3
+
+
+# ---------------------------------------------------------------------------
+# sweep-lane parity: vmapped seed batch == sequential runs
+# ---------------------------------------------------------------------------
+
+
+def test_seed_batch_parity_vs_sequential(simkit):
+    """The vmapped (seed x lane) batch equals sequential single-seed
+    runs of the SAME compiled walk - nothing leaks across batch lanes
+    (the smoke job class's folding contract)."""
+    from jaxtlc.sim.engine import SimEngine
+
+    eng = SimEngine(simkit["model"], walkers=_WALKERS, depth=_DEPTH,
+                    fp_capacity=_FPCAP, check_deadlock=False, width=3)
+    items = [(1, None), (2, None), (3, None)]
+    batch = eng.run(items)
+    seq = eng.run_sequential(items)
+    for b, s in zip(batch, seq):
+        assert _same_result(b, s)
+    assert {b.seed for b in batch} == {1, 2, 3}
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM -> -recover cursor continuity
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_recover_cursor_continuity(simkit, tmp_path):
+    """A SIGTERM mid-run drains, checkpoints the (seed, step) cursor,
+    and the -recover resume's final result is EXACTLY the
+    uninterrupted run's; a wrong-seed resume is a loud mismatch."""
+    from jaxtlc.resil.faults import FaultPlan
+    from jaxtlc.sim.driver import run_sim_supervised
+
+    ck = str(tmp_path / "CK")
+    kw = dict(walkers=_WALKERS, depth=_DEPTH, fp_capacity=_FPCAP,
+              check_deadlock=False, ckpt_every=4)
+    events = []
+    sup = run_sim_supervised(
+        simkit["model"], seed=7, ckpt_path=ck,
+        faults=FaultPlan.parse("sigterm@2"),
+        on_event=lambda k, i: events.append((k, i)), **kw,
+    )
+    assert sup.interrupted and sup.ckpt_writes >= 1
+    assert any(k == "interrupted" for k, _ in events)
+    assert sup.result.steps < _DEPTH
+    resumed = run_sim_supervised(simkit["model"], seed=7,
+                                 ckpt_path=ck, resume=True, **kw)
+    assert not resumed.interrupted
+    clean = run_sim_supervised(simkit["model"], seed=7, **kw)
+    assert _same_result(resumed.result, clean.result)
+    # a walk is a pure function of its seed: resuming another seed's
+    # cursor must be rejected before any segment runs
+    with pytest.raises(ValueError, match="seed mismatch"):
+        run_sim_supervised(simkit["model"], seed=8, ckpt_path=ck,
+                           resume=True, **kw)
+
+
+# ---------------------------------------------------------------------------
+# artifact-cache honesty: sim verdicts never publish
+# ---------------------------------------------------------------------------
+
+
+def test_clean_sim_run_bypasses_artifact_cache(simkit, tmp_path):
+    """A CLEAN sim run journals an explicit cache BYPASS and writes NO
+    artifact: a simulation verdict is from incomplete search, and a
+    poisoned verdict tier would silently answer later exhaustive
+    queries.  Geometry matches the module fixture, so this api run
+    performs zero fresh engine compiles."""
+    from jaxtlc.api import CheckRequest, run_check
+    from jaxtlc.struct import artifacts as arts
+
+    store_root = str(tmp_path / "store")
+    token = arts.configure(store_root)
+    try:
+        out = io.StringIO()
+        oc = run_check(CheckRequest(
+            config=simkit["cfg"], workers="cpu", frontend="struct",
+            simulate=True, walkers=_WALKERS, depth=_DEPTH,
+            simseed=7, fpcap=_FPCAP, nodeadlock=True, noTool=True,
+            checkpointevery=4,  # the fixture segment cadence: the
+            # supervised-path memo makes this run compile-free
+            out=out, err=out,
+            journal=str(tmp_path / "bypass.journal.jsonl"),
+        ))
+        assert oc.exit_code == 0 and oc.verdict == "ok"
+        assert "NOT exhaustive" in out.getvalue()
+        written = [
+            os.path.join(r, f)
+            for r, _d, files in os.walk(store_root) for f in files
+        ]
+        assert written == [], written
+    finally:
+        arts.restore(token)
+    from jaxtlc.obs import journal as jr
+
+    events = jr.read(str(tmp_path / "bypass.journal.jsonl"))
+    byp = [e for e in events if e["event"] == "cache"]
+    assert byp and byp[0]["outcome"] == "bypass"
+    assert byp[0]["tier"] == "verdict"
+    summary = [e for e in events if e["event"] == "sim"
+               and e["phase"] == "summary"]
+    assert summary and summary[0]["walkers"] == _WALKERS
+    assert summary[0]["steps"] == _DEPTH

@@ -152,6 +152,22 @@ def test_loadgen_tiny_smoke(capsys):
     assert report["scheduler"]["batched_jobs"] == report["sweep_jobs"]
 
 
+def test_loadgen_sim_tiny_smoke(capsys):
+    """tools/loadgen.py --sim --tiny: the smoke job class under load -
+    1 cold + 3 warm sim submits (different seeds, ONE warm engine,
+    zero fresh XLA compiles asserted) plus a folded seed-batch burst
+    (ISSUE 14 CI wiring; the sim engine is tiny)."""
+    mod = _load_tool("loadgen")
+    assert mod.main(["--sim", "--tiny"]) == 0
+    out = capsys.readouterr().out
+    assert "loadgen OK" in out, out
+    report = json.loads(out[: out.index("loadgen OK")])
+    assert report["sim_fresh_xla_compiles"] == 0
+    assert report["pool"]["hits"] >= report["jobs"] - 1
+    assert report["sim_p50_s"] <= report["sim_p95_s"]
+    assert report["transitions"] > 0
+
+
 def test_cachectl_tiny_smoke(capsys):
     """tools/cachectl.py --tiny: synthetic artifact store -> ls ->
     verify (clean + after a deliberate corruption) -> gc to a byte
@@ -219,6 +235,9 @@ def test_bench_emit_enforces_payload_contract(capsys):
         # ISSUE 12: which commit dedup produced the number rides every
         # payload, exactly like the pipeline flag
         assert "sort_free" in payload
+        # ISSUE 14: which SEARCH produced the number (exhaustive BFS
+        # vs the random-walk simulation tier) rides every payload too
+        assert "sim" in payload
     # both emissions were journaled as validated bench_metric events
     kinds = [e["event"] for e in bench._JOURNAL.events]
     assert kinds.count("bench_metric") == 2

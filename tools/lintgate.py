@@ -27,7 +27,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # the registry is jax-free, so this stays an engine-free gate
 REQUIRED_FACTORIES = (
     "covered", "enumerator", "fused", "narrowed", "phased",
-    "pipelined", "sharded", "sortfree", "spill", "struct", "sweep",
+    "pipelined", "sharded", "sim", "sortfree", "spill", "struct",
+    "sweep",
 )
 
 

@@ -201,6 +201,72 @@ def run_cache(url: str, jobs: int, in_process: bool,
     return report
 
 
+def run_sim_load(url: str, jobs: int, in_process: bool,
+                 out=sys.stdout) -> dict:
+    """The --sim mode (ISSUE 14): the smoke job class under load.
+    Submit 1 cold + N-1 warm sim jobs (same spec, DIFFERENT seeds -
+    the seed is a batch lane, not key material, so every resubmit
+    after the first must be a pool HIT with ZERO fresh XLA compiles),
+    then one folded burst submitted together to exercise the vmapped
+    seed batch."""
+    from jaxtlc.serve import client
+    from jaxtlc.serve.pool import xla_compiles
+
+    opts = dict(simulate=True, walkers=16, depth=32, fpcap=1024,
+                nodeadlock=True)
+    t0 = time.time()
+    cold = client.check(url, _SPEC, _CFG, name="sim-cold",
+                        options=dict(opts, simseed=0))
+    cold_s = time.time() - t0
+    assert cold["state"] == "done", cold
+    assert cold["result"]["engine"] == "sim", cold
+    assert cold["result"]["verdict"] == "ok", cold
+
+    warm_lat = []
+    pre = xla_compiles() if in_process else None
+    for i in range(max(0, jobs - 1)):
+        t0 = time.time()
+        st = client.check(url, _SPEC, _CFG, name=f"sim-warm-{i}",
+                          options=dict(opts, simseed=i + 1))
+        warm_lat.append(time.time() - t0)
+        assert st["state"] == "done", st
+        assert st["result"]["engine"] == "sim", st
+        assert st["result"]["pool_hit"] is True, st
+    fresh = (xla_compiles() - pre) if in_process else 0
+    assert fresh == 0, f"warm sim path paid {fresh} fresh XLA compiles"
+
+    # a burst submitted together folds into vmapped seed batches
+    ids = [client.submit(url, _SPEC, _CFG, name=f"sim-burst-{i}",
+                         options=dict(opts, simseed=100 + i))
+           for i in range(jobs)]
+    t0 = time.time()
+    sts = [client.wait(url, i, timeout=600) for i in ids]
+    burst_s = time.time() - t0
+    for st in sts:
+        assert st["state"] == "done", st
+        assert st["result"]["engine"] == "sim", st
+
+    stats = client.pool_stats(url)
+    report = dict(
+        jobs=jobs,
+        cold_s=round(cold_s, 4),
+        sim_p50_s=round(_pct(warm_lat, 0.50), 4),
+        sim_p95_s=round(_pct(warm_lat, 0.95), 4),
+        sim_fresh_xla_compiles=fresh,
+        burst_wall_s=round(burst_s, 4),
+        transitions=cold["result"]["sim"]["transitions"],
+        pool=dict(hits=stats["pool"]["hits"],
+                  misses=stats["pool"]["misses"],
+                  size=stats["pool"]["size"]),
+        scheduler=dict(
+            batches_run=stats["scheduler"]["batches_run"],
+            batched_jobs=stats["scheduler"]["batched_jobs"],
+        ),
+    )
+    out.write(json.dumps(report, indent=2, sort_keys=True) + "\n")
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="loadgen")
     p.add_argument("--url", default="",
@@ -210,6 +276,12 @@ def main(argv=None) -> int:
                    help="plain submits of one model (1 cold + N-1 warm)")
     p.add_argument("--sweep-jobs", type=int, default=4,
                    help="sweep submits folded into batched dispatches")
+    p.add_argument("--sim", action="store_true",
+                   help="smoke job class mode (ISSUE 14): 1 cold + "
+                        "N-1 warm sim submits (different seeds, same "
+                        "warm engine - zero fresh XLA compiles "
+                        "asserted) plus a folded seed-batch burst; "
+                        "reports warm sim p50/p95")
     p.add_argument("--cache", action="store_true",
                    help="incremental re-checking mode (ISSUE 13): N "
                         "identical submits; 1 cold population run, "
@@ -246,6 +318,21 @@ def main(argv=None) -> int:
 
             srv = start_server(sweep_width=4)
             url = srv.url
+        if args.sim:
+            report = run_sim_load(url, args.jobs,
+                                  in_process=srv is not None)
+            ok = (report["sim_fresh_xla_compiles"] == 0
+                  and report["pool"]["hits"] >= args.jobs - 1)
+            print(f"loadgen {'OK' if ok else 'FAILED'}: "
+                  f"{args.jobs} sim submits (1 cold + "
+                  f"{args.jobs - 1} warm) + {args.jobs} burst, "
+                  f"warm sim p50 {report['sim_p50_s'] * 1000:.1f} ms "
+                  f"/ p95 {report['sim_p95_s'] * 1000:.1f} ms, "
+                  f"0 fresh compiles on the warm path, "
+                  f"{report['scheduler']['batched_jobs']} jobs "
+                  f"through {report['scheduler']['batches_run']} "
+                  "dispatches")
+            return 0 if ok else 1
         if args.cache:
             report = run_cache(url, args.jobs, in_process=srv is not None)
             ok = (report["hit_fresh_xla_compiles"] == 0

@@ -133,6 +133,20 @@ def render(events) -> str:
             f"{sp.get('hits', 0) / probes:.1%} of {sp.get('probes', 0):,}"
             " probes"
         )
+    # simulation tier (jaxtlc.sim): the walk cursor + the sampled
+    # distinct estimate of the most recent sim event (a smoke run's
+    # whole progress story - walks carry no frontier/queue)
+    sim = next((e for e in reversed(events) if e["event"] == "sim"),
+               None)
+    if sim is not None:
+        est = sim.get("distinct_est", 0)
+        sat = " (saturated)" if sim.get("fp_saturated") else ""
+        lines.append(
+            f"sim: {sim['walkers']} walkers  depth "
+            f"{sim['steps']}/{sim['depth']}  "
+            f"{sim['transitions']:,} transitions  "
+            f"~{est:,} distinct sampled{sat}"
+        )
     # incremental re-checking (struct.artifacts): this run's artifact
     # cache decisions - a hit means the verdict was replayed (or BFS
     # skipped) instead of re-explored
