@@ -51,6 +51,14 @@ Usage:
                                # signature AND fpset TABLE words
                                # bit-equality gated (the ISSUE 12
                                # exactness contract)
+    python bench.py --expand-ab  # Model_1 at chunk 2048 (sort-free
+                               # on both sides) with -deferred-inv vs
+                               # -no-deferred-inv, AOT compiles
+                               # shared, timed runs interleaved
+                               # best-of-5: inv_ms_saved metric line +
+                               # both rates, full signature AND fpset
+                               # TABLE words bit-equality gated (the
+                               # ISSUE 15 exactness contract)
     python bench.py --sim      # simulation tier (ISSUE 14): Model_1
                                # random walks vs the chunk-matched BFS
                                # engine, both AOT once, interleaved
@@ -822,6 +830,143 @@ def bench_commit_ab(probe_err: str) -> int:
     return 0
 
 
+def bench_expand_ab(probe_err: str) -> int:
+    """--expand-ab: A/B the distinct-first deferred invariant/cert
+    evaluation against the immediate per-candidate expand (the ISSUE
+    15 acceptance harness).
+
+    Runs Model_1 at chunk 2048 (the regime where the fitted cost model
+    puts the invariant sweep at the top of the step - COSTMODEL.json
+    v3 splits the old inv_fp wall to show it) through BOTH engines -
+    `-no-deferred-inv` and `-deferred-inv`, sort-free commit on both
+    sides (the chunk-2048 auto default) - AOT-compiled once each, with
+    the timed runs INTERLEAVED (immediate/deferred per repeat,
+    best-of-5): sequential best-of-2 on this CPU shows +-3% phantom
+    effects (PERF.md round 8 methodology note).  Gate: the deferred
+    run must be BIT-FOR-BIT the immediate run - verdict, full
+    signature AND the final fpset TABLE words - or the harness reports
+    failure instead of a number.  Emits an `inv_ms_saved` line (the
+    per-step invariant-evaluation wall saved, from the v3 differential
+    sub-phase profiler at the same chunk) and the rate line carrying
+    both rates plus `states_per_s_delta_pct`.  CPU walls stand in for
+    the chip per the standing tunnel caveat; the committed
+    COSTMODEL.json v3 carries the inv-ms reduction."""
+    device_note = ""
+    if probe_err:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        device_note = f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
+    import jax
+    import numpy as np
+
+    from jaxtlc.config import MODEL_1
+    from jaxtlc.engine.backend import kubeapi_backend
+    from jaxtlc.engine.bfs import make_engine, result_from_carry
+    from jaxtlc.obs.phases import subphase_walls
+
+    workload = "Model_1"
+    kw = dict(chunk=2048, queue_capacity=1 << 15, fp_capacity=1 << 20)
+    compiled = {}
+    for df in (False, True):
+        init_fn, run_fn, _ = make_engine(
+            MODEL_1, **kw, donate=False, sort_free=True, deferred=df,
+        )
+        carry0 = init_fn()
+        compiled[df] = (run_fn.lower(carry0).compile(), carry0)
+
+    walls = {False: [], True: []}
+    finals = {}
+    for _ in range(5):
+        for df in (False, True):
+            fn, carry0 = compiled[df]
+            t0 = time.time()
+            out = jax.block_until_ready(fn(carry0))
+            walls[df].append(time.time() - t0)
+            finals[df] = out
+
+    results = {}
+    for df, out in finals.items():
+        r = result_from_carry(out, min(walls[df]),
+                              fp_capacity=kw["fp_capacity"])
+        if r.violation or (
+            r.generated, r.distinct, r.depth
+        ) != EXPECT[workload]:
+            _emit({"error": f"deferred={df} count mismatch: "
+                            f"{(r.generated, r.distinct, r.depth)}",
+                   "workload": workload, "deferred": df})
+            return 1
+        results[df] = r
+
+    def signature(r):
+        return (r.generated, r.distinct, r.depth, r.violation,
+                tuple(sorted(r.action_generated.items())),
+                tuple(sorted(r.action_distinct.items())),
+                r.outdegree, r.fp_occupancy)
+
+    # exactness is the contract: verdict + full signature + TABLE words
+    if signature(results[False]) != signature(results[True]) or not (
+        np.asarray(finals[False].fps.table)
+        == np.asarray(finals[True].fps.table)
+    ).all():
+        _emit({"error": "deferred run is not bit-identical to the "
+                        "immediate engine", "workload": workload,
+               "deferred": True})
+        return 1
+
+    # invariant-evaluation attribution at the same chunk: the v3
+    # differential sub-phase profiler's "inv" column in both modes
+    backend = kubeapi_backend(MODEL_1)
+    inv_ms = {}
+    for df in (False, True):
+        w = subphase_walls(backend, kw["chunk"], kw["queue_capacity"],
+                           kw["fp_capacity"], sort_free=True,
+                           deferred=df)
+        inv_ms[df] = 1e3 * w["inv"]
+
+    wall_imm, wall_def = min(walls[False]), min(walls[True])
+    rate_def = results[True].distinct / wall_def
+    rate_imm = results[False].distinct / wall_imm
+    device = str(jax.devices()[0]) + device_note
+    _emit(
+        {
+            "metric": "inv_ms_saved",
+            "value": round(inv_ms[False] - inv_ms[True], 3),
+            "unit": "ms/step",
+            "workload": workload,
+            "chunk": kw["chunk"],
+            "inv_ms_immediate": round(inv_ms[False], 3),
+            "inv_ms_deferred": round(inv_ms[True], 3),
+            "wall_s_immediate": round(wall_imm, 3),
+            "wall_s_deferred": round(wall_def, 3),
+            "states_per_s_delta_pct": round(
+                100.0 * (rate_def - rate_imm) / rate_imm, 3
+            ),
+            "repeats": 5,
+            "sort_free": True,
+            "deferred": True,
+            "device": device,
+        }
+    )
+    _emit(
+        {
+            "value": round(rate_def, 1),
+            "vs_baseline": round(rate_def / TLC_DISTINCT_PER_S, 2),
+            "workload": workload,
+            "rate_deferred": round(rate_def, 1),
+            "rate_immediate": round(rate_imm, 1),
+            "generated": results[True].generated,
+            "distinct": results[True].distinct,
+            "depth": results[True].depth,
+            "wall_s": round(wall_def, 3),
+            "sort_free": True,
+            "deferred": True,
+            "device": device,
+        }
+    )
+    return 0
+
+
 def bench_cov_ab(probe_err: str) -> int:
     """--cov-ab: measure the cost of the device coverage plane.
 
@@ -1040,6 +1185,8 @@ def main() -> int:
         return bench_sim(probe_err)
     if "--commit-ab" in sys.argv:
         return bench_commit_ab(probe_err)
+    if "--expand-ab" in sys.argv:
+        return bench_expand_ab(probe_err)
     if "--cov-ab" in sys.argv:
         return bench_cov_ab(probe_err)
     if "--obs-ab" in sys.argv:

@@ -169,6 +169,31 @@ def _build_sortfree():
                 n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
 
 
+def _build_deferred():
+    # the distinct-first deferred-evaluation engine (ISSUE 15): the
+    # same TwoPhase model as "struct" but with invariant + certificate
+    # evaluation moved to the commit stage (fresh-insert claimants
+    # only), the obs ring riding along - the commit-site checker's
+    # gather/while_loop path cannot ship unaudited
+    import os
+
+    from ..engine.bfs import make_backend_engine
+    from ..struct.cache import get_backend
+    from ..struct.loader import load
+
+    d = _specs_dir()
+    if d is None:
+        raise FileNotFoundError("specs/ directory not found")
+    model = load(os.path.join(d, "TwoPhase.toolbox", "Model_1",
+                              "MC.cfg"))
+    b = get_backend(model, True)
+    init_fn, run_fn, step_fn = make_backend_engine(
+        b, donate=False, obs_slots=8, deferred=True, **_TINY
+    )
+    return dict(init_fn=init_fn, run_fn=run_fn, step_fn=step_fn,
+                n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
+
+
 def _build_sim():
     # the random-walk simulation engine (jaxtlc.sim, ISSUE 14): the
     # same TwoPhase model as "struct", walked with the counter-based
@@ -303,6 +328,7 @@ def _build_phased():
 # by tier-1 so a new engine path cannot ship unaudited
 FACTORIES: Dict[str, Callable[[], dict]] = {
     "covered": _build_covered,
+    "deferred": _build_deferred,
     "fused": _build_fused,
     "narrowed": _build_narrowed,
     "phased": _build_phased,

@@ -53,6 +53,13 @@ class CheckRequest:
     # tri-state -sort-free/-no-sort-free: None = auto (the engines
     # resolve it against the chunk, engine.bfs.resolve_sort_free)
     sortfree: Optional[bool] = None
+    # tri-state -deferred-inv/-no-deferred-inv (ISSUE 15): None = auto
+    # (resolved against the chunk, engine.bfs.resolve_deferred) -
+    # invariant/certificate evaluation on the fresh-insert claimants
+    # at the commit stage instead of every chunk*L candidate lane.
+    # The -simulate tier ignores it: every walker state is "fresh", so
+    # the sim engines keep their immediate per-walker invariant path.
+    deferredinv: Optional[bool] = None
     routefactor: float = 2.0
     qcap: int = 1 << 15
     fpcap: int = 1 << 20
@@ -230,6 +237,7 @@ def _run_check(args) -> int:
                     fp_capacity=args.fpcap, sharded=args.sharded,
                     pipeline=args.pipeline,
                     sort_free=_sort_free(args),
+                    deferred=_deferred(args),
                     obs_slots=_obs_slots(args)),
     )
 
@@ -428,6 +436,7 @@ def _dispatch_check(args, spec, log):
                 obs_slots=_obs_slots(args),
                 coverage=args.coverage,
                 sort_free=args.sortfree,
+                deferred=args.deferredinv,
                 opts=_sup_opts(args, log),
             )
             return sup.result, sup
@@ -445,6 +454,7 @@ def _dispatch_check(args, spec, log):
             pipeline=args.pipeline,
             obs_slots=_obs_slots(args),
             sort_free=args.sortfree,
+            deferred=args.deferredinv,
         ), None
     if args.fpset == "DiskFPSet":
         # the OffHeapDiskFPSet/DiskStateQueue analog: authoritative dedup +
@@ -482,6 +492,7 @@ def _dispatch_check(args, spec, log):
             obs_slots=_obs_slots(args),
             coverage=args.coverage,
             sort_free=args.sortfree,
+            deferred=args.deferredinv,
             opts=_sup_opts(args, log),
         )
         return sup.result, sup
@@ -497,6 +508,7 @@ def _dispatch_check(args, spec, log):
         obs_slots=_obs_slots(args),
         coverage=args.coverage,
         sort_free=args.sortfree,
+        deferred=args.deferredinv,
     ), None
 
 
@@ -596,6 +608,16 @@ def _sort_free(args) -> bool:
     from .engine.bfs import resolve_sort_free
 
     return resolve_sort_free(getattr(args, "sortfree", None), args.chunk)
+
+
+def _deferred(args) -> bool:
+    """The RESOLVED -deferred-inv mode this run's engines will use
+    (journal manifests record the fact, not the tri-state; the same
+    resolve the engine factories / memos / checkpoint meta compute)."""
+    from .engine.bfs import resolve_deferred
+
+    return resolve_deferred(getattr(args, "deferredinv", None),
+                            args.chunk)
 
 
 def _open_journal(args, workload: str, engine: str, device: str,
@@ -702,6 +724,10 @@ def _resume_command(args) -> str:
         # auto re-resolves identically from the chunk; only an explicit
         # override must travel so the meta mode check stays satisfied
         parts += ["-sort-free" if args.sortfree else "-no-sort-free"]
+    if getattr(args, "deferredinv", None) is not None:
+        # same contract as -sort-free: auto re-resolves from the chunk
+        parts += ["-deferred-inv" if args.deferredinv
+                  else "-no-deferred-inv"]
     if getattr(args, "narrow", False):
         parts += ["-narrow"]  # the narrowed codec is a different layout
     if getattr(args, "coverage", False):
@@ -811,6 +837,7 @@ def _run_check_gen(args, spec) -> int:
             pipeline=args.pipeline,
             obs_slots=_obs_slots(args),
             sort_free=args.sortfree,
+            deferred=args.deferredinv,
         )
         if args.checkpoint:
             meta_config = {
@@ -962,6 +989,7 @@ def _run_check_struct(args, spec) -> int:
                     pipeline=args.pipeline,
                     obs_slots=_obs_slots(args),
                     sort_free=args.sortfree,
+                    deferred=args.deferredinv,
                     opts=_sup_opts(args, log), **kw,
                 )
                 return sup.result, sup
@@ -969,7 +997,8 @@ def _run_check_struct(args, spec) -> int:
                 sm, mesh, route_factor=args.routefactor,
                 check_deadlock=ckd, pipeline=args.pipeline,
                 obs_slots=_obs_slots(args), bounds=bounds,
-                coverage=cov, sort_free=args.sortfree, **kw,
+                coverage=cov, sort_free=args.sortfree,
+                deferred=args.deferredinv, **kw,
             ), None
         if args.checkpoint or args.autogrow:
             from .resil import check_supervised
@@ -983,6 +1012,7 @@ def _run_check_struct(args, spec) -> int:
                 pipeline=args.pipeline,
                 obs_slots=_obs_slots(args),
                 sort_free=args.sortfree,
+                deferred=args.deferredinv,
                 opts=_sup_opts(args, log, capture_fps=capture), **kw,
             )
             return sup.result, sup
@@ -990,7 +1020,7 @@ def _run_check_struct(args, spec) -> int:
             sm, fp_index=spec.fp_index, check_deadlock=ckd,
             pipeline=args.pipeline, obs_slots=_obs_slots(args),
             bounds=bounds, coverage=cov, sort_free=args.sortfree,
-            capture_fps=capture, **kw,
+            deferred=args.deferredinv, capture_fps=capture, **kw,
         ), None
 
     def props():
@@ -1421,6 +1451,7 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
                     fp_capacity=args.fpcap, sharded=args.sharded,
                     pipeline=args.pipeline, frontend=kit.kind,
                     sort_free=_sort_free(args),
+                    deferred=_deferred(args),
                     obs_slots=_obs_slots(args)),
     )
     # incremental re-checking (ISSUE 13): try the artifact tiers BEFORE

@@ -108,6 +108,36 @@ def main(argv=None) -> int:
     c.add_argument("-no-sort-free", dest="sortfree", action="store_const",
                    const=False,
                    help="force the sorted dedup commit at any chunk")
+    c.add_argument("-deferred-inv", dest="deferredinv",
+                   action="store_const", const=True, default=None,
+                   help="distinct-first expand (ISSUE 15): evaluate "
+                        "invariants and the certified-bound check at "
+                        "the commit stage, on the fresh-insert "
+                        "claimants only, instead of on every chunk*L "
+                        "candidate lane - TLC checks a state when it "
+                        "is first generated, and first generation IS "
+                        "the distinct fpset insert.  Inherited by "
+                        "every engine at the expand/commit seam "
+                        "(fused, -pipeline, -sharded owner-side, "
+                        "spill, -phase-timing, -narrow, -coverage); "
+                        "-simulate ignores it (every walker state is "
+                        "fresh - the sim tier keeps its immediate "
+                        "per-walker invariant path).  Verdict, "
+                        "counters, fpset table words and rendered "
+                        "traces are bit-for-bit the immediate "
+                        "path's (bench.py --expand-ab gates it); the "
+                        "reported violating LANE follows the pinned "
+                        "highest-lane rule (the PR 12 dedup rep "
+                        "convention) instead of first-lane.  Default "
+                        "auto: on at -chunk >= 2048, where the "
+                        "fitted cost model shows the invariant "
+                        "sweep dominating the step (COSTMODEL.json); "
+                        "off below.  A checkpoint records the "
+                        "resolved mode: -recover must match")
+    c.add_argument("-no-deferred-inv", dest="deferredinv",
+                   action="store_const", const=False,
+                   help="force immediate per-candidate invariant/cert "
+                        "evaluation at any chunk")
     c.add_argument("-routefactor", type=float, default=2.0,
                    help="sharded all_to_all bucket size as a multiple of "
                         "the mean per-owner candidate count (raise after "

@@ -29,6 +29,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..config import ModelConfig
 from ..spec.codec import get_codec
@@ -110,10 +111,17 @@ class ExpandOut(NamedTuple):
     # (None on backends without a coverage plane, so coverage-off
     # carries/stages keep their exact pytree layout)
     cov: jnp.ndarray = None
+    # [chunk*L, F] int32 RAW (pre-pack) successor fields - present only
+    # in deferred-evaluation mode (ISSUE 15), where the commit stage
+    # gathers the fresh-insert claimants from it and runs invariants +
+    # the certificate there, at probe width instead of candidate width.
+    # None in immediate mode, so pre-deferred carries/stages keep their
+    # exact pytree layout.
+    flat: jnp.ndarray = None
 
 
 def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
-                      fp_index: int, seed: int):
+                      fp_index: int, seed: int, deferred: bool = False):
     """Build the expand half of an engine step over `backend`'s seam:
     unpack -> vmapped successor kernel -> invariants -> pack ->
     MXU fingerprints -> per-action generated counters -> first-wins
@@ -122,8 +130,27 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
     Returns expand(batch [chunk, F] int32, mask [chunk] bool) ->
     ExpandOut.  Both the fused (unpipelined) body and the pipelined
     body call this one function, so the split cannot drift; a backend
-    may override it wholesale via SpecBackend.expand."""
+    may override it wholesale via SpecBackend.expand.
+
+    deferred=True (ISSUE 15, a RESOLVED bool - factories resolve the
+    tri-state flag via bfs.resolve_deferred) SKIPS the per-candidate
+    invariant and certificate evaluation here: the commit stage runs
+    them instead, over the fresh-insert claimants only (TLC checks a
+    state when it is first generated, and first generation IS the
+    distinct fpset insert), via make_deferred_checker.  The stage then
+    carries the raw pre-pack fields in ExpandOut.flat for the commit-
+    side gather, and its first-wins violation reduce covers only the
+    kernel-derived codes (assert > deadlock > slot) - the deferred
+    invariant verdict outranks them at the commit merge.  Everything
+    else (kernel, packing, MXU fingerprints, per-action counters,
+    coverage counting - guard-reach semantics stay pre-dedup) is
+    unchanged."""
     if backend.expand is not None:
+        if deferred:
+            # an override must opt into the deferred contract
+            # explicitly (return flat, skip inv/cert)
+            return backend.expand(backend, chunk, check_deadlock,
+                                  fp_index, seed, deferred=True)
         return backend.expand(backend, chunk, check_deadlock,
                               fp_index, seed)
     cdc = backend.cdc
@@ -155,11 +182,17 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
         fvalid = valid.reshape(-1)
         faction = action.reshape(-1)
 
-        inv = jax.vmap(inv_check)(flat)
-        inv_bad = [
-            fvalid & ((inv & (1 << k)) == 0)
-            for k in range(len(inv_codes))
-        ]
+        # deferred mode: invariants + certificate run at the commit
+        # stage on the fresh-insert claimants only (the distinct-first
+        # collapse this stage exists to enable - chunk*L candidate
+        # lanes down to ~probe-width rows)
+        inv_bad = []
+        if not deferred:
+            inv = jax.vmap(inv_check)(flat)
+            inv_bad = [
+                fvalid & ((inv & (1 << k)) == 0)
+                for k in range(len(inv_codes))
+            ]
 
         packed = cdc.pack(flat)
         lo, hi = fp64_words_mxu(packed, nbits, fp_index, seed)
@@ -167,8 +200,10 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
         # runtime certificate: verify the claimed bounds on the RAW
         # (pre-pack) fields of every valid successor - escapes that
         # would wrap into a legal-looking packed word are still caught
+        # (deferred mode keeps the pre-pack property by gathering from
+        # the raw ExpandOut.flat rows at the commit site)
         cert = None
-        if backend.cert_check is not None:
+        if not deferred and backend.cert_check is not None:
             cert = backend.cert_check(flat, fvalid)
 
         # device coverage plane (ISSUE 11): this block's per-site
@@ -229,9 +264,117 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
             packed=packed, lo=lo, hi=hi, valid=fvalid, action=faction,
             gen=gen, viol=viol, viol_state=viol_state,
             viol_action=viol_action, cert=cert, cov=cov,
+            flat=flat if deferred else None,
         )
 
     return expand
+
+
+def make_deferred_checker(backend: SpecBackend, n: int,
+                          probe_width: int = 0,
+                          with_cert: bool = True):
+    """Commit-stage invariant + certificate evaluation over the fresh-
+    insert claimants (ISSUE 15: distinct-first expand).
+
+    Semantics: TLC checks a state's invariants when it is FIRST
+    generated, and first generation is by definition a fresh fpset
+    insert - so checking only the `is_new` claimant rows preserves the
+    verdict of the immediate (per-candidate) evaluation.  The two
+    deliberate narrowings, both the fingerprint-collision risk class
+    TLC itself carries (MC.out:39-42): (a) a state whose fingerprint
+    collides with an already-stored state is never re-checked (TLC
+    never re-checks it either - it is not even enqueued), and (b) the
+    certificate telemetry sees only fresh claimants, so a bound escape
+    whose WRAPPED packed word fingerprints onto an already-seen class
+    can evade the cert column for that block (interval lies still
+    self-defend through the kept codec trap - analysis.absint; the
+    cardinality-lie catch is pinned in tests/test_deferred.py).
+
+    Violation-lane attribution rule (pinned, layout-independent): the
+    reported state is the violating fresh claimant with the HIGHEST
+    original candidate lane - the same rep convention as the PR 12
+    dedup (in-batch duplicates resolve to the highest lane), identical
+    across the sorted and slab commit layouts because it is defined on
+    original lanes, not compacted positions.  The immediate path
+    reports the FIRST violating candidate instead; everything else
+    (verdict code, counters, table words, rendered traces) is
+    bit-for-bit.
+
+    Returns check(flat [n, F] int32, faction [n] int32 or None,
+    is_new_c [n] bool, c_idx [n] int32, nreps) ->
+    (viol, viol_state [F], viol_action, cert-or-None): the claimant
+    slice is walked in probe-width segments (one segment in steady
+    state: new-per-chunk ~ chunk <= R), each an [R, F] row gather +
+    one R-wide vmapped invariant kernel - the whole point: R ~ 2*chunk
+    rows instead of chunk*L candidate lanes."""
+    inv_check = backend.inv_check
+    inv_codes = backend.inv_codes
+    cert_fn = backend.cert_check if with_cert else None
+    F = backend.cdc.n_fields
+    n_codes = len(inv_codes)
+    R = min(probe_width or n, n)
+    nseg = (n + R - 1) // R
+    pad = nseg * R - n
+
+    def check(flat, faction, is_new_c, c_idx, nreps):
+        idx_p = jnp.concatenate(
+            [c_idx, jnp.full(pad, n, jnp.int32)]
+        ) if pad else c_idx
+        new_p = jnp.concatenate(
+            [is_new_c, jnp.zeros(pad, bool)]
+        ) if pad else is_new_c
+
+        def cond(st):
+            return (st[0] * R < nreps) & (st[0] < nseg)
+
+        def body(st):
+            seg, bad_any, bad_lane, cert_bad = st
+            off = seg * R
+            idx = lax.dynamic_slice(idx_p, (off,), (R,))
+            fresh = lax.dynamic_slice(new_p, (off,), (R,))
+            # slab padding rows carry the sentinel lane n (fresh is
+            # False there, so the clamped gather is never consumed)
+            lanes = jnp.clip(idx, 0, n - 1)
+            rows = flat[lanes]  # [R, F]: the one per-claimant gather
+            if n_codes:
+                inv = jax.vmap(inv_check)(rows)
+            for k in range(n_codes):
+                bad = fresh & ((inv & (1 << k)) == 0)
+                bad_any = bad_any.at[k].set(bad_any[k] | bad.any())
+                bad_lane = bad_lane.at[k].max(
+                    jnp.max(jnp.where(bad, idx, -1))
+                )
+            if cert_fn is not None:
+                cert_bad = cert_bad | cert_fn(rows, fresh)
+            return seg + 1, bad_any, bad_lane, cert_bad
+
+        _, bad_any, bad_lane, cert_bad = lax.while_loop(
+            cond, body,
+            (jnp.int32(0), jnp.zeros(n_codes, bool),
+             jnp.full(n_codes, -1, jnp.int32), jnp.bool_(False)),
+        )
+
+        # first-wins across codes (inv_codes order, matching the
+        # immediate reduce); within a code, the max-lane rule above
+        viol = jnp.int32(OK)
+        lane = jnp.int32(-1)
+        for k, code in enumerate(inv_codes):
+            hit = bad_any[k] & (viol == OK)
+            viol = jnp.where(hit, jnp.int32(code), viol)
+            lane = jnp.where(hit, bad_lane[k], lane)
+        safe = jnp.clip(lane, 0, n - 1)
+        hitv = viol != OK
+        viol_state = jnp.where(hitv, flat[safe], jnp.zeros(F, jnp.int32))
+        viol_action = jnp.where(
+            hitv,
+            faction[safe].astype(jnp.int32) if faction is not None
+            else jnp.int32(-1),
+            jnp.int32(-1),
+        )
+        cert = cert_bad if cert_fn is not None else None
+        return viol, viol_state, viol_action, cert
+
+    return check
 
 
 def kubeapi_backend(cfg: ModelConfig,

@@ -139,6 +139,11 @@ class EngineCarry(NamedTuple):
     # error verdict by the check drivers - never silent.
     cert_viol: jnp.ndarray = None  # bool
     st_cert: jnp.ndarray = None  # staged block's cert bit (pipelined)
+    # staged block's raw pre-pack fields ([chunk*L, F] int32): present
+    # only on deferred-evaluation pipelined carries (ISSUE 15), where
+    # the commit gathers the fresh-insert claimants from it.  None
+    # leaves vanish, so immediate-mode carries keep their layout.
+    st_flat: jnp.ndarray = None
     # --- device coverage plane (None without a backend coverage plane)
     # Cumulative [n_sites] uint32 per-site visit counters (obs.coverage,
     # ISSUE 11): incremented by every commit from the expand stage's
@@ -222,6 +227,30 @@ def resolve_sort_free(sort_free, chunk: int) -> bool:
     return chunk >= SORT_FREE_AUTO_CHUNK
 
 
+# -deferred-inv auto threshold (ISSUE 15): the fitted cost model
+# (COSTMODEL.json v2) puts the invariant+fingerprint subphase at 69%
+# of the sort-free step at chunk 2048 (14.2 of 20.6 ms) - the
+# per-candidate chunk*L invariant evaluation is the dominant lever
+# there, and deferring it to the ~2*chunk fresh-insert claimants is
+# the distinct-first collapse.  At small chunks the claimant gather +
+# segment loop is overhead against a cheap candidate sweep, so auto
+# keeps the immediate evaluation - same shape, and deliberately the
+# same threshold, as the sort-free auto rule.
+DEFERRED_AUTO_CHUNK = 2048
+
+
+def resolve_deferred(deferred, chunk: int) -> bool:
+    """Resolve the tri-state -deferred-inv flag (None = auto) for an
+    engine popping `chunk` states per step.  Deterministic in the
+    geometry alone - exactly like resolve_sort_free - so engine memos,
+    EnginePool keys, checkpoint meta, resume commands and journal
+    run_start params all compute the same answer without
+    coordination."""
+    if deferred is not None:
+        return bool(deferred)
+    return chunk >= DEFERRED_AUTO_CHUNK
+
+
 def make_engine(
     cfg: ModelConfig,
     chunk: int = 1024,
@@ -235,6 +264,7 @@ def make_engine(
     obs_slots: int = 0,
     coverage: bool = False,
     sort_free: bool = None,
+    deferred: bool = None,
 ):
     """Build (init_fn, run_fn, step_fn) for one KubeAPI configuration.
 
@@ -250,7 +280,7 @@ def make_engine(
         kubeapi_backend(cfg, coverage=coverage), chunk, queue_capacity,
         fp_capacity, fp_index, seed, fp_highwater=fp_highwater,
         pipeline=pipeline, donate=donate, obs_slots=obs_slots,
-        sort_free=sort_free,
+        sort_free=sort_free, deferred=deferred,
     )
 
 
@@ -267,6 +297,7 @@ def make_stage_pair(
     obs_slots: int = 0,
     spill: bool = False,
     sort_free: bool = False,
+    deferred: bool = False,
 ):
     """(pop_expand, commit) at pop width `ck` - the two halves of one
     BFS step, shared by every composition: the unpipelined body runs
@@ -283,6 +314,19 @@ def make_stage_pair(
     covered) inherits the mode with no per-engine code.  The slab is an
     ephemeral per-commit tensor derived from this pair's geometry, so
     regrow/chunk-shrink rebuilds migrate it by construction.
+
+    deferred=True (a RESOLVED bool; factories resolve the tri-state
+    flag via resolve_deferred) moves invariant + certificate
+    evaluation from the expand stage to THIS commit, running them only
+    on the fresh-insert claimants (backend.make_deferred_checker: TLC
+    checks a state when first generated, and first generation is the
+    distinct insert) - ~probe-width rows instead of chunk*L candidate
+    lanes (ISSUE 15).  Verdict, counters, fpset TABLE words and
+    rendered traces are bit-for-bit the immediate path's; only the
+    violation-LANE attribution changes, to the pinned highest-lane
+    rule (the checker docstring).  Because both modes meet at this one
+    seam, every composed engine - fused, pipelined, spill, phased,
+    narrowed, covered - inherits the mode with no per-engine code.
 
     spill=True builds the commit for spill mode: it takes an extra
     `veto` mask ([ck * n_lanes] bool, candidates the HOST fingerprint
@@ -316,8 +360,16 @@ def make_stage_pair(
     CW = min(2 * ck, R)  # fpset round-0 claim width
     A = min(2 * ck, ncand)  # enqueue/stat segment width
     expand_fn = make_expand_stage(
-        backend, ck, check_deadlock, fp_index, seed
+        backend, ck, check_deadlock, fp_index, seed, deferred=deferred
     )
+    # deferred-evaluation checker (ISSUE 15): invariants + certificate
+    # over the fresh-insert claimants, at the probe width the insert
+    # already compacts to.  None when there is nothing to check.
+    checker = None
+    if deferred and (backend.inv_codes or backend.cert_check is not None):
+        from .backend import make_deferred_checker
+
+        checker = make_deferred_checker(backend, ncand, probe_width=R)
 
     def pop_expand(c: EngineCarry):
         """Expand stage: contiguous pop + backend expand.  Reads only
@@ -459,11 +511,24 @@ def make_stage_pair(
         generated = c.generated + ex.valid.sum().astype(jnp.uint32)
         distinct = c.distinct + n_new.astype(jnp.uint32)
 
-        # violations, first wins: carried > expand-stage (invariant >
-        # assert > deadlock > slot, pre-reduced in ex) > capacity
+        # violations, first wins: carried > deferred invariant (when
+        # evaluation is deferred, checked on the fresh claimants just
+        # inserted - outranking the kernel-derived codes exactly as
+        # the immediate reduce orders invariant > assert) >
+        # expand-stage (invariant > assert > deadlock > slot,
+        # pre-reduced in ex) > capacity
         viol = c.viol
         viol_state = c.viol_state
         viol_action = c.viol_action
+        d_cert = None
+        if checker is not None:
+            d_viol, d_state, d_action, d_cert = checker(
+                ex.flat, ex.action, is_new_c, c_idx, nreps
+            )
+            hit = (d_viol != OK) & (viol == OK)
+            viol = jnp.where(hit, d_viol, viol)
+            viol_state = jnp.where(hit, d_state, viol_state)
+            viol_action = jnp.where(hit, d_action, viol_action)
         hit = (ex.viol != OK) & (viol == OK)
         viol = jnp.where(hit, ex.viol, viol)
         viol_state = jnp.where(hit, ex.viol_state, viol_state)
@@ -491,10 +556,13 @@ def make_stage_pair(
                 veto & ex.valid
             ).sum().astype(jnp.uint32)
         cert_now = None
-        if ex.cert is not None and c.cert_viol is not None:
+        cert_src = d_cert if deferred else ex.cert
+        if cert_src is not None and c.cert_viol is not None:
             # sticky: once any block's certificate check fired, every
-            # later carry (and ring row) carries the flag
-            cert_now = c.cert_viol | ex.cert
+            # later carry (and ring row) carries the flag (deferred
+            # mode latches it from the commit-site checker instead of
+            # the staged expand bit - same column, same stickiness)
+            cert_now = c.cert_viol | cert_src
             extra["cert_viol"] = cert_now
         if ex.cov is not None and c.cov_counts is not None:
             # device coverage plane: fold this block's per-site visit
@@ -572,6 +640,7 @@ def make_backend_engine(
     donate: bool = True,
     obs_slots: int = 0,
     sort_free: bool = None,
+    deferred: bool = None,
 ):
     """Build (init_fn, run_fn, step_fn) over any SpecBackend.
 
@@ -629,13 +698,29 @@ def make_backend_engine(
     the flag is purely a performance mode, but it is still recorded in
     engine memos and checkpoint meta so a resume can never silently
     cross modes.
+
+    deferred (tri-state: None = auto, resolve_deferred) moves
+    invariant + certificate evaluation to the commit stage, over the
+    fresh-insert claimants only (ISSUE 15; make_stage_pair docstring).
+    Verdict, full counter signature, fpset TABLE words and rendered
+    traces are bit-for-bit the immediate path's (bench.py --expand-ab
+    gates it); violation-LANE attribution follows the pinned
+    highest-lane rule.  Like sort_free, the resolved mode is engine-
+    memo and checkpoint-meta material - a wrong-mode -recover is a
+    loud pre-build rejection - because the pipelined staged-block
+    layout changes (st_flat replaces st_cert) and attribution must
+    never silently flip across a resume.
     """
     from ..obs.counters import ring_new
     from .backend import ExpandOut
 
     assert 0.0 < fp_highwater <= 1.0, "fp_highwater must be in (0, 1]"
     sort_free = resolve_sort_free(sort_free, chunk)
+    deferred = resolve_deferred(deferred, chunk)
     has_cert = backend.cert_check is not None
+    # in deferred mode the staged ExpandOut carries the raw fields
+    # (st_flat) and no cert bit (the commit-site checker derives it)
+    stage_cert = has_cert and not deferred
     cov_plane = backend.coverage
     n_sites = cov_plane.n_sites if cov_plane is not None else 0
     cdc = backend.cdc
@@ -707,10 +792,12 @@ def make_backend_engine(
                 st_viol_state=jnp.zeros(F, jnp.int32),
                 st_viol_action=jnp.int32(-1),
             )
-            if has_cert:
+            if stage_cert:
                 staged["st_cert"] = jnp.bool_(False)
             if cov_plane is not None:
                 staged["st_cov"] = jnp.zeros(n_sites, jnp.uint32)
+            if deferred:
+                staged["st_flat"] = jnp.zeros((ncand_full, F), jnp.int32)
         if has_cert:
             staged["cert_viol"] = jnp.bool_(False)
         if cov_plane is not None:
@@ -757,7 +844,7 @@ def make_backend_engine(
             backend, ck, queue_capacity=qcap, fp_capacity=fp_capacity,
             fp_highwater=fp_highwater, check_deadlock=check_deadlock,
             fp_index=fp_index, seed=seed, obs_slots=obs_slots,
-            sort_free=sort_free,
+            sort_free=sort_free, deferred=deferred,
         )
 
     def make_body(ck: int):
@@ -775,9 +862,11 @@ def make_backend_engine(
         pop_expand, commit = make_stages(chunk)
 
         def with_staged(c: EngineCarry, ex, n) -> EngineCarry:
-            extra = {"st_cert": ex.cert} if has_cert else {}
+            extra = {"st_cert": ex.cert} if stage_cert else {}
             if cov_plane is not None:
                 extra["st_cov"] = ex.cov
+            if deferred:
+                extra["st_flat"] = ex.flat
             return c._replace(
                 st_packed=ex.packed, st_lo=ex.lo, st_hi=ex.hi,
                 st_valid=ex.valid, st_action=ex.action, st_gen=ex.gen,
@@ -791,8 +880,9 @@ def make_backend_engine(
                 valid=c.st_valid, action=c.st_action, gen=c.st_gen,
                 viol=c.st_viol, viol_state=c.st_viol_state,
                 viol_action=c.st_viol_action,
-                cert=c.st_cert if has_cert else None,
+                cert=c.st_cert if stage_cert else None,
                 cov=c.st_cov if cov_plane is not None else None,
+                flat=c.st_flat if deferred else None,
             )
 
         # The two-deep pipeline body, bubble-free: the staged block k-1
@@ -883,6 +973,7 @@ def check(
     obs_slots: int = 0,
     coverage: bool = False,
     sort_free: bool = None,
+    deferred: bool = None,
 ) -> CheckResult:
     """Run an exhaustive check; the single-device engine entry point.
 
@@ -896,7 +987,7 @@ def check(
     init_fn, run_fn, _ = make_backend_engine(
         backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater=fp_highwater, pipeline=pipeline, obs_slots=obs_slots,
-        sort_free=sort_free,
+        sort_free=sort_free, deferred=deferred,
     )
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()

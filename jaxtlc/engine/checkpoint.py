@@ -255,6 +255,7 @@ def check_with_checkpoints(
     pipeline: bool = False,
     obs_slots: int = 0,
     sort_free: bool = None,
+    deferred: bool = None,
 ) -> CheckResult:
     """Exhaustive check with periodic checkpoints every `ckpt_every` chunks.
 
@@ -273,15 +274,16 @@ def check_with_checkpoints(
     jax.block_until_ready only at the next boundary - checkpoint/coverage
     readback stays off the device critical path (PERF.md round 7).
     """
-    from .bfs import resolve_sort_free
+    from .bfs import resolve_deferred, resolve_sort_free
 
     sort_free = resolve_sort_free(sort_free, chunk)
+    deferred = resolve_deferred(deferred, chunk)
     # donate=False: segment k's output is serialized to disk while
     # segment k+1 (fed the same arrays) is in flight
     init_fn, _, step_fn = make_engine(
         cfg, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater=fp_highwater, pipeline=pipeline, donate=False,
-        obs_slots=obs_slots, sort_free=sort_free,
+        obs_slots=obs_slots, sort_free=sort_free, deferred=deferred,
     )
     meta = _meta(
         cfg,
@@ -294,6 +296,7 @@ def check_with_checkpoints(
         pipeline=pipeline,
         obs_slots=obs_slots,
         sort_free=sort_free,
+        deferred=deferred,
     )
 
     @jax.jit
@@ -313,11 +316,12 @@ def check_with_checkpoints(
         # across a resume)
         for key in ("format", "config", "chunk", "queue_capacity",
                     "fp_capacity", "fp_index", "seed", "fp_highwater",
-                    "pipeline", "obs_slots", "sort_free"):
-            # pre-pipeline/pre-obs/pre-sort-free snapshots carry no
-            # key: treat as off
+                    "pipeline", "obs_slots", "sort_free", "deferred"):
+            # pre-pipeline/pre-obs/pre-sort-free/pre-deferred
+            # snapshots carry no key: treat as off
             saved = saved_meta.get(
-                key, False if key in ("pipeline", "sort_free")
+                key, False if key in ("pipeline", "sort_free",
+                                      "deferred")
                 else 0 if key == "obs_slots" else None)
             if saved != meta[key]:
                 raise ValueError(

@@ -29,9 +29,13 @@ minimizes them.
   ``occupancy + rank-in-run``, so round-0 insertions cannot collide - no
   claim-verify round trip for the common case.
 * **Straggler path**: candidates whose home bucket is (or becomes) full
-  walk buckets linearly; each walk round re-sorts the compacted straggler
-  slice by its CURRENT bucket and rank-claims again, so straggler writes
-  are conflict-free too.  No claim-verify exists anywhere: slot writes
+  walk buckets linearly; each walk round rank-claims against the
+  CURRENT bucket so straggler writes are conflict-free too.  The round's
+  rank arbitration has two bit-identical forms (ISSUE 15): a re-sort of
+  the straggler slice by current bucket (the CPU form), or the dense
+  [S, S] bucket-coincidence reduction per the BLEST tensor-core BFS
+  papers (the accelerator form - no comparator network in the walk;
+  `JAXTLC_DENSE_WALK` overrides the platform auto).  No claim-verify exists anywhere: slot writes
   are a pair of element scatters (lo column, hi column), and with every
   claim targeting a distinct slot, scatter duplicate-resolution order can
   never tear a row (a verify-based loop would live-lock on a backend that
@@ -336,10 +340,43 @@ def fpset_member(s: FPSet, lo, hi, mask,
     return found
 
 
-def _probe_block(table, lo, hi, active, claim_width: int):
+def _dense_walk_default() -> bool:
+    """Whether the straggler claim walk runs its dense rank-claim form
+    (ISSUE 15, per the BLEST tensor-core BFS formulation): the per-
+    round 4-key comparator sort over the straggler slice is replaced
+    by an [S, S] bucket-coincidence x fingerprint-order mask reduced
+    row-wise to in-bucket ranks - a dense segmented reduction with no
+    comparator network anywhere in the walk.  BIT-FOR-BIT either way
+    (the rank a lane claims with is identical - tests/test_fpset and
+    tests/test_deferred pin both forms against each other and the host
+    oracle), so the choice is pure schedule, NOT memo/meta material:
+    auto takes the dense form on accelerators, where comparator sorts
+    are the measured cost (PAPERS.md: BLEST; Graph Traversal on Tensor
+    Cores), and keeps the sort on CPU, where the [S, S] mask is.
+    JAXTLC_DENSE_WALK=1/0 forces it (read at trace time)."""
+    import os
+
+    v = os.environ.get("JAXTLC_DENSE_WALK", "auto").lower()
+    if v in ("1", "true", "on"):
+        return True
+    if v in ("0", "false", "off"):
+        return False
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+def _probe_block(table, lo, hi, active, claim_width: int,
+                 dense_walk: bool = None):
     """Insert-or-find `active` entries of a fingerprint block that is
     sorted ascending by (hi, lo) and duplicate-free.  Returns
-    (table, is_new).  table: [nb, 2B]; lo/hi/active: [R]."""
+    (table, is_new).  table: [nb, 2B]; lo/hi/active: [R].
+
+    dense_walk selects the straggler-walk arbitration form (None =
+    platform auto, _dense_walk_default); both forms produce identical
+    verdicts AND identical table words."""
+    if dense_walk is None:
+        dense_walk = _dense_walk_default()
     nb = table.shape[0]
     cap = nb * BUCKET
     R = lo.shape[0]
@@ -413,6 +450,46 @@ def _probe_block(table, lo, hi, active, claim_width: int):
             _, _, pend, _, _ = wst
             return pend.any()
 
+        def walk_body_dense(wst):
+            # dense rank-claim round (ISSUE 15, BLEST formulation): the
+            # slice stays in ITS OWN order - no per-round sort.  Each
+            # pending lane gathers its current bucket row (the
+            # membership test needs the stored words), and the in-
+            # bucket claim rank comes from one [S, S] bucket-
+            # coincidence x fingerprint-order mask reduced row-wise: a
+            # dense segmented reduction (VPU/MXU-shaped) in place of
+            # the 5-array 4-key comparator sort.  Ranks are identical
+            # to the sorted round's (the slice is duplicate-free, so
+            # ascending (lo, hi) is a strict order), hence identical
+            # slot targets and identical table words.
+            table, cur_b, pend, new, k = wst
+            row = table[jnp.where(pend, cur_b, 0)]  # [S, 2B]
+            rlo, rhi = row[:, 0::2], row[:, 1::2]
+            f = pend & (
+                (rlo == s_lo[:, None]) & (rhi == s_hi[:, None])
+            ).any(1)
+            occ = ((rlo != 0) | (rhi != 0)).sum(axis=1).astype(jnp.int32)
+            wnt = pend & ~f
+            same = (
+                wnt[:, None] & wnt[None, :]
+                & (cur_b[:, None] == cur_b[None, :])
+            )
+            less = (s_lo[None, :] < s_lo[:, None]) | (
+                (s_lo[None, :] == s_lo[:, None])
+                & (s_hi[None, :] < s_hi[:, None])
+            )
+            rnk = (same & less).sum(axis=1).astype(jnp.int32)
+            sl = occ + rnk
+            ok = wnt & (sl < BUCKET)
+            table = _slot_write(table, cur_b * BUCKET + sl, s_lo, s_hi,
+                                ok)
+            new = new | ok
+            pend2 = pend & ~(f | ok)
+            # unsettled claimants advance to the next bucket
+            cur_b = jnp.where(wnt & ~ok & pend2, (cur_b + 1) % nb,
+                              cur_b)
+            return table, cur_b, pend2, new, k + 1
+
         def walk_body(wst):
             table, cur_b, pend, new, k = wst
             # sort the slice by current bucket so same-bucket claimants
@@ -451,7 +528,8 @@ def _probe_block(table, lo, hi, active, claim_width: int):
             return table, cur_b, pend2, new, k + 1
 
         table, _, _, s_new, _ = lax.while_loop(
-            walk_cond, walk_body,
+            walk_cond,
+            walk_body_dense if dense_walk else walk_body,
             (table, s_bid, s_act, jnp.zeros(S, bool), jnp.int32(0)),
         )
         upd_pos = jnp.where(s_act, s_pos, R)

@@ -122,6 +122,7 @@ class PhasedRuntime:
                  fp_capacity: int, fp_index: int = None, seed: int = None,
                  fp_highwater: float = None, check_deadlock: bool = None,
                  obs_slots: int = 0, sort_free: bool = None,
+                 deferred: bool = None,
                  recorder: Optional[PhaseRecorder] = None):
         import jax
 
@@ -129,6 +130,7 @@ class PhasedRuntime:
             DEFAULT_FP_HIGHWATER,
             make_backend_engine,
             make_stage_pair,
+            resolve_deferred,
             resolve_sort_free,
         )
         from ..engine.fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
@@ -138,6 +140,7 @@ class PhasedRuntime:
         fp_highwater = (DEFAULT_FP_HIGHWATER if fp_highwater is None
                         else fp_highwater)
         sort_free = resolve_sort_free(sort_free, chunk)
+        deferred = resolve_deferred(deferred, chunk)
         self.recorder = recorder if recorder is not None else PhaseRecorder()
         self.chunk = chunk
         # init template through the production factory (jits are lazy)
@@ -145,6 +148,7 @@ class PhasedRuntime:
             backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
             fp_highwater=fp_highwater, check_deadlock=check_deadlock,
             donate=False, obs_slots=obs_slots, sort_free=sort_free,
+            deferred=deferred,
         )
         self._base_init = init_fn
 
@@ -154,6 +158,7 @@ class PhasedRuntime:
                 fp_capacity=fp_capacity, fp_highwater=fp_highwater,
                 check_deadlock=check_deadlock, fp_index=fp_index,
                 seed=seed, obs_slots=obs_slots, sort_free=sort_free,
+                deferred=deferred,
             )
             expand_fn = jax.jit(lambda c: pop_expand(c))
             commit_fn = jax.jit(
@@ -240,7 +245,8 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
                    fp_capacity: int, warm_steps: int = 8,
                    K: int = 4, reps: int = 3,
                    check_deadlock: bool = None,
-                   sort_free: bool = False) -> Dict[str, float]:
+                   sort_free: bool = False,
+                   deferred: bool = False) -> Dict[str, float]:
     """Differential sub-phase attribution on a warmed mid-run carry.
 
     Drives the real engine `warm_steps` steps (realistic frontier block
@@ -248,7 +254,20 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
     the step by subtraction:
 
         kernel        pop + unpack + vmap(step)           (measured)
-        inv_fp        expand - kernel: invariant eval + MXU fingerprints
+        inv           the invariant + certificate MACHINERY at its
+                      mode's site, measured as an ISOLATED body (not
+                      a difference of stage walls - a sub-ms signal
+                      drowns in the noise of two ~10 ms probes):
+                      immediate = the chunk*L invariant sweep plus its
+                      bad-mask and first-wins any/argmax/gather
+                      consumers, composed exactly as the expand stage
+                      composes them; deferred (ISSUE 15) = the
+                      commit-site claimant checker over a real
+                      insert's compacted verdicts - same column, so
+                      the before/after of the distinct-first collapse
+                      lines up
+        fp            the expand-stage remainder: pack + MXU
+                      fingerprints + counters + the violation reduce
         expand        the full expand stage                 (measured)
         sort          the in-batch dedup stage: the two full-width
                       stable sorts of fpset_insert_sorted, or (under
@@ -257,9 +276,13 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
                       so before/after cost models line up
         probe         insert - sort: the fpset probe/claim walk
         enqueue       step - expand - insert: enqueue + stats + fencing
-        commit        step - expand
+        commit        step - expand (deferred mode: includes the
+                      claimant checker, which the `inv` column then
+                      attributes)
         step          the real fused step_fn                (measured)
 
+    v2 reported `inv_fp` as one wall; v3 (ISSUE 15) splits it so the
+    fit can see which half the deferred evaluation actually moves.
     Returns seconds/step per phase.  CPU numbers are the committed
     COSTMODEL baseline until the TPU tunnel returns (ROADMAP standing
     item); the tool records the device either way."""
@@ -274,6 +297,7 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
 
     cdc = backend.cdc
     W = (cdc.nbits + 31) // 32
+    F = cdc.n_fields
     L = backend.n_lanes
     ncand = chunk * L
     R = min(2 * chunk, ncand)
@@ -281,7 +305,7 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
     init_fn, _, step_fn = make_backend_engine(
         backend, chunk, queue_capacity, fp_capacity,
         check_deadlock=check_deadlock, donate=False,
-        sort_free=sort_free,
+        sort_free=sort_free, deferred=deferred,
     )
     carry = init_fn()
     for _ in range(warm_steps):
@@ -295,25 +319,96 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
     batch = cdc.unpack(block)
     mask_all = jnp.ones(chunk, bool)
     expand_stage = make_expand_stage(
-        backend, chunk, check_deadlock, DEFAULT_FP_INDEX, DEFAULT_SEED
+        backend, chunk, check_deadlock, DEFAULT_FP_INDEX, DEFAULT_SEED,
+        deferred=deferred,
     )
     ex = jax.block_until_ready(expand_stage(batch, mask_all))
     step = backend.step
 
-    # kernel: pop + unpack + vmapped successor kernel only
+    # kernel: pop + unpack + vmapped successor kernel only (all five
+    # outputs folded so XLA cannot slice the kernel - see _consume)
     def b_kernel(c):
         b = cdc.unpack(block ^ c[None, :])
         s, v, a, af, ov = jax.vmap(step)(b)
-        return c ^ s[0, 0, :1].astype(jnp.uint32)
+        return c ^ (
+            s.sum().astype(jnp.uint32) + v.sum().astype(jnp.uint32)
+            + a.sum().astype(jnp.uint32) + af.sum().astype(jnp.uint32)
+            + ov.sum().astype(jnp.uint32)
+        )
 
     t_kernel = _fused_time(b_kernel, jnp.zeros(W, jnp.uint32), K, reps)
 
-    # expand: the full seam stage (kernel + invariants + fingerprints)
+    # full-consumption fold: the inv/fp columns are DIFFERENCES of
+    # expand-stage probes, so every probe must materialize everything
+    # the real stage hands to commit - a partially-consumed ExpandOut
+    # lets XLA slice the computation and understate the phase (the v2
+    # inv_fp column partly suffered this)
+    def _consume(e):
+        return (e.packed.sum() + e.lo.sum() + e.hi.sum()
+                + e.valid.sum().astype(jnp.uint32)
+                + e.action.sum().astype(jnp.uint32) + e.gen.sum()
+                + e.viol.astype(jnp.uint32))
+
+    # the invariant-free expand stage (the deferred stage IS the
+    # immediate stage minus the invariant/cert machinery); its wall
+    # anchors the `fp` column, and its ExpandOut carries the raw
+    # fields both isolated inv probes below consume
+    stage_noinv = (expand_stage if deferred else make_expand_stage(
+        backend, chunk, check_deadlock, DEFAULT_FP_INDEX, DEFAULT_SEED,
+        deferred=True,
+    ))
+
     def b_expand(c):
         e = expand_stage(cdc.unpack(block ^ c[None, :]), mask_all)
-        return c ^ e.lo[:1]
+        return c ^ _consume(e)
 
     t_expand = _fused_time(b_expand, jnp.zeros(W, jnp.uint32), K, reps)
+
+    if deferred:
+        t_expand_noinv = t_expand
+        ex_def = ex
+    else:
+        def b_expand_noinv(c):
+            e = stage_noinv(cdc.unpack(block ^ c[None, :]), mask_all)
+            return c ^ _consume(e)
+
+        t_expand_noinv = _fused_time(
+            b_expand_noinv, jnp.zeros(W, jnp.uint32), K, reps
+        )
+        ex_def = jax.block_until_ready(stage_noinv(batch, mask_all))
+
+    # the `inv` column: BOTH sites measured as isolated machinery
+    # bodies over the same candidate block, not as differences of
+    # ~10x-larger stage walls (a diff of two noisy 9 ms measurements
+    # drowns a sub-ms signal - the v3 design note).  Immediate: the
+    # chunk*L invariant sweep plus its consumers exactly as
+    # make_expand_stage composes them (bad masks + the first-wins
+    # any/argmax/gather entries).  Deferred: the commit-site claimant
+    # checker over a real insert's compacted verdicts.
+    flat0 = ex_def.flat
+    inv_check = backend.inv_check
+    inv_codes = backend.inv_codes
+
+    def b_inv_imm(x):
+        fl = flat0 + x
+        iv = jax.vmap(inv_check)(fl)
+        viol = jnp.int32(0)
+        vstate = jnp.zeros(F, jnp.int32)
+        vact = jnp.int32(-1)
+        for k, code in enumerate(inv_codes):
+            bad = ex.valid & ((iv & (1 << k)) == 0)
+            hit = bad.any() & (viol == 0)
+            viol = jnp.where(hit, jnp.int32(code), viol)
+            vstate = jnp.where(hit, fl[jnp.argmax(bad)], vstate)
+            vact = jnp.where(
+                hit, ex.action[jnp.argmax(bad)].astype(jnp.int32), vact
+            )
+        cert = jnp.int32(0)
+        if backend.cert_check is not None:
+            cert = backend.cert_check(fl, ex.valid).astype(jnp.int32)
+        return x + viol + vstate.sum() + vact + cert
+
+    t_inv_imm = _fused_time(b_inv_imm, jnp.int32(0), K, reps)
 
     # sort: the in-batch dedup stage - the two full-width stable sorts,
     # or the hash-slab dedup that replaces them under -sort-free
@@ -355,6 +450,33 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
 
     t_ins = _fused_time(b_ins, (carry.fps, jnp.uint32(1)), K, reps)
 
+    # deferred mode's inv site, isolated the same way: the claimant
+    # checker alone, over the compacted verdicts of a REAL insert of
+    # this block (computed once, held constant; the raw fields vary
+    # per rep to defeat caching)
+    t_inv_def = None
+    if deferred:
+        from ..engine.backend import make_deferred_checker
+
+        checker = make_deferred_checker(backend, ncand, probe_width=R)
+        _, is_new0, c_idx0, nreps0 = jax.block_until_ready(
+            fpset_insert_dedup(
+                carry.fps, ex.lo, ex.hi, ex.valid,
+                probe_width=R, claim_width=R, sort_free=sort_free,
+            )
+        )
+
+        def b_inv_def(x):
+            dv, ds, da, dc = checker(
+                flat0 + x, ex.action, is_new0, c_idx0, nreps0
+            )
+            y = x + dv + ds.sum() + da
+            if dc is not None:
+                y = y + dc.astype(jnp.int32)
+            return y
+
+        t_inv_def = _fused_time(b_inv_def, jnp.int32(0), K, reps)
+
     # step: the engine's own jitted step (one dispatch per call)
     jax.block_until_ready(step_fn(carry))
     best = float("inf")
@@ -369,10 +491,17 @@ def subphase_walls(backend, chunk: int, queue_capacity: int,
 
     t_probe = max(t_ins - t_sort, 0.0)
     t_commit = max(t_step - t_expand, 0.0)
-    t_enqueue = max(t_step - t_expand - t_ins, 0.0)
+    t_fp = max(t_expand_noinv - t_kernel, 0.0)
+    if deferred:
+        t_inv = t_inv_def
+        t_enqueue = max(t_step - t_expand - t_ins - t_inv, 0.0)
+    else:
+        t_inv = t_inv_imm
+        t_enqueue = max(t_step - t_expand - t_ins, 0.0)
     return {
         "kernel": t_kernel,
-        "inv_fp": max(t_expand - t_kernel, 0.0),
+        "inv": t_inv,
+        "fp": t_fp,
         "expand": t_expand,
         "sort": t_sort,
         "probe": t_probe,

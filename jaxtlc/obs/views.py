@@ -317,6 +317,12 @@ _BENCH_BASE = {
     # (False) or the random-walk simulation tier (True - walks/s
     # payloads, bench.py --sim)
     "sim": False,
+    # which expand mode produced the number (ISSUE 15): immediate
+    # per-candidate invariant/cert evaluation (False) or the
+    # distinct-first deferred evaluation on the fresh-insert
+    # claimants (True - bench.py --expand-ab); modes that run both
+    # put their setting in explicitly, like "pipeline"/"sort_free"
+    "deferred": False,
 }
 
 

@@ -180,6 +180,13 @@ def migrate_engine_carry(
         staged["st_cert"] = jnp.asarray(
             np.asarray(carry.st_cert), bool
         )
+    # deferred-evaluation staged raw fields (ISSUE 15): chunk-shaped
+    # like the rest of the staged block, geometry-independent - travel
+    # verbatim (the chunk re-seat path asserts st_n is None above)
+    if getattr(carry, "st_flat", None) is not None:
+        staged["st_flat"] = jnp.asarray(
+            np.asarray(carry.st_flat), jnp.int32
+        )
     # device coverage counters: telemetry, shape depends on neither
     # capacity - travel verbatim so per-site history survives regrow
     for f in ("cov_counts", "st_cov"):

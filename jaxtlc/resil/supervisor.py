@@ -278,7 +278,7 @@ class SingleDeviceAdapter:
     GEOM_KEYS = ("queue_capacity", "fp_capacity")
     FIXED_KEYS = ("format", "config", "chunk", "fp_index", "seed",
                   "fp_highwater", "pipeline", "obs_slots", "coverage",
-                  "sort_free")
+                  "sort_free", "deferred")
 
     def __init__(self, cfg, chunk: int = 1024,
                  fp_index: int = DEFAULT_FP_INDEX, seed: int = DEFAULT_SEED,
@@ -286,8 +286,8 @@ class SingleDeviceAdapter:
                  backend=None, meta_config: dict = None,
                  check_deadlock: bool = True, pipeline: bool = False,
                  obs_slots: int = 0, coverage: bool = False,
-                 sort_free: bool = None):
-        from ..engine.bfs import resolve_sort_free
+                 sort_free: bool = None, deferred: bool = None):
+        from ..engine.bfs import resolve_deferred, resolve_sort_free
 
         self.cfg = cfg
         self.chunk = chunk
@@ -295,6 +295,7 @@ class SingleDeviceAdapter:
         # chunk-shrink keeps the mode (the slab is rebuilt from the new
         # stage-pair geometry; meta stays consistent across the resume)
         self.sort_free = resolve_sort_free(sort_free, chunk)
+        self.deferred = resolve_deferred(deferred, chunk)
         self.fp_index = fp_index
         self.seed = seed
         self.fp_highwater = fp_highwater
@@ -329,6 +330,7 @@ class SingleDeviceAdapter:
                 check_deadlock=self.check_deadlock,
                 pipeline=self.pipeline, donate=False,
                 obs_slots=self.obs_slots, sort_free=self.sort_free,
+                deferred=self.deferred,
             )
         else:
             init_fn, _, step_fn = make_engine(
@@ -337,6 +339,7 @@ class SingleDeviceAdapter:
                 fp_highwater=self.fp_highwater,
                 pipeline=self.pipeline, donate=False,
                 obs_slots=self.obs_slots, sort_free=self.sort_free,
+                deferred=self.deferred,
             )
 
         @jax.jit
@@ -357,7 +360,7 @@ class SingleDeviceAdapter:
             fp_index=self.fp_index, seed=self.seed,
             fp_highwater=self.fp_highwater, pipeline=self.pipeline,
             obs_slots=self.obs_slots, coverage=self.coverage,
-            sort_free=self.sort_free,
+            sort_free=self.sort_free, deferred=self.deferred,
             **params,
         )
 
@@ -430,7 +433,7 @@ class SingleDeviceAdapter:
             params["fp_capacity"], fp_index=self.fp_index,
             seed=self.seed, fp_highwater=self.fp_highwater,
             check_deadlock=check_deadlock, obs_slots=self.obs_slots,
-            sort_free=self.sort_free,
+            sort_free=self.sort_free, deferred=self.deferred,
             store=store, on_event=on_event,
             spill_write_hook=spill_write_hook,
         )
@@ -459,7 +462,7 @@ class SingleDeviceAdapter:
             params["fp_capacity"], fp_index=self.fp_index,
             seed=self.seed, fp_highwater=self.fp_highwater,
             check_deadlock=check_deadlock, obs_slots=self.obs_slots,
-            sort_free=self.sort_free,
+            sort_free=self.sort_free, deferred=self.deferred,
             recorder=recorder,
         )
         return rt.init_fn(), rt.segment_fn(ckpt_every)
@@ -501,20 +504,23 @@ class ShardedAdapter:
     kind = "sharded"
     GEOM_KEYS = ("queue_capacity", "fp_capacity", "route_factor")
     FIXED_KEYS = ("format", "config", "devices", "fp_highwater",
-                  "pipeline", "obs_slots", "coverage", "sort_free")
+                  "pipeline", "obs_slots", "coverage", "sort_free",
+                  "deferred")
 
     def __init__(self, cfg, mesh, chunk: int = 512, backend=None,
                  meta_config: dict = None,
                  fp_highwater: float = DEFAULT_FP_HIGHWATER,
                  pipeline: bool = False, obs_slots: int = 0,
-                 coverage: bool = False, sort_free: bool = None):
-        from ..engine.bfs import resolve_sort_free
+                 coverage: bool = False, sort_free: bool = None,
+                 deferred: bool = None):
+        from ..engine.bfs import resolve_deferred, resolve_sort_free
         from ..engine.sharded import kubeapi_backend
 
         self.cfg = cfg
         self.mesh = mesh
         self.chunk = chunk
         self.sort_free = resolve_sort_free(sort_free, chunk)
+        self.deferred = resolve_deferred(deferred, chunk)
         self.backend = (backend if backend is not None
                         else kubeapi_backend(cfg, coverage=coverage))
         self.meta_config = meta_config
@@ -532,7 +538,7 @@ class ShardedAdapter:
             route_factor=params["route_factor"], segment=ckpt_every,
             backend=self.backend, fp_highwater=self.fp_highwater,
             pipeline=self.pipeline, obs_slots=self.obs_slots,
-            sort_free=self.sort_free,
+            sort_free=self.sort_free, deferred=self.deferred,
         )
         template = init_fn()
         compiled = seg_fn.lower(template).compile()
@@ -545,7 +551,7 @@ class ShardedAdapter:
             devices=int(self.mesh.devices.size),
             fp_highwater=self.fp_highwater, pipeline=self.pipeline,
             obs_slots=self.obs_slots, coverage=self.coverage,
-            sort_free=self.sort_free,
+            sort_free=self.sort_free, deferred=self.deferred,
             **params,
         )
 
@@ -617,11 +623,11 @@ def _params_from_meta(adapter, meta: dict, params: dict) -> dict:
     travel with the snapshot, so the resume command needs none of them)."""
     want = adapter.meta(params)
     for key in adapter.FIXED_KEYS:
-        # pre-pipeline/pre-obs/pre-coverage/pre-sort-free snapshots
-        # carry no key: they were cut from engines without those
-        # features, so missing means off
+        # pre-pipeline/pre-obs/pre-coverage/pre-sort-free/pre-
+        # deferred snapshots carry no key: they were cut from engines
+        # without those features, so missing means off
         have = meta.get(key, False if key in ("pipeline", "coverage",
-                                              "sort_free")
+                                              "sort_free", "deferred")
                         else 0 if key == "obs_slots" else None)
         if have != want.get(key):
             raise ValueError(
@@ -1272,6 +1278,7 @@ def check_supervised(
     obs_slots: int = 0,
     coverage: bool = False,
     sort_free: bool = None,
+    deferred: bool = None,
     opts: SupervisorOptions = None,
 ) -> SupervisedResult:
     """Supervised single-device exhaustive check (the check_with_
@@ -1285,7 +1292,7 @@ def check_supervised(
         fp_highwater=fp_highwater, backend=backend,
         meta_config=meta_config, check_deadlock=check_deadlock,
         pipeline=pipeline, obs_slots=obs_slots, coverage=coverage,
-        sort_free=sort_free,
+        sort_free=sort_free, deferred=deferred,
     )
     return supervise(
         adapter,
@@ -1308,6 +1315,7 @@ def check_sharded_supervised(
     obs_slots: int = 0,
     coverage: bool = False,
     sort_free: bool = None,
+    deferred: bool = None,
     opts: SupervisorOptions = None,
 ) -> SupervisedResult:
     """Supervised mesh-sharded exhaustive check (capacities PER DEVICE)."""
@@ -1315,6 +1323,7 @@ def check_sharded_supervised(
         cfg, mesh, chunk=chunk, backend=backend, meta_config=meta_config,
         fp_highwater=fp_highwater, pipeline=pipeline,
         obs_slots=obs_slots, coverage=coverage, sort_free=sort_free,
+        deferred=deferred,
     )
     return supervise(
         adapter,

@@ -103,7 +103,7 @@ class _SingleRunner:
 
     def __init__(self, model, chunk, queue_capacity, fp_capacity,
                  fp_index, seed, check_deadlock, pipeline, obs_slots,
-                 sort_free=None):
+                 sort_free=None, deferred=None):
         from ..engine.bfs import DEFAULT_FP_HIGHWATER
         from ..struct.cache import get_backend, get_engine
 
@@ -114,6 +114,7 @@ class _SingleRunner:
             model, chunk, queue_capacity, fp_capacity, fp_index, seed,
             DEFAULT_FP_HIGHWATER, check_deadlock=check_deadlock,
             pipeline=pipeline, obs_slots=obs_slots, sort_free=sort_free,
+            deferred=deferred,
         )
         import jax
 
@@ -217,6 +218,7 @@ class EnginePool:
         pipeline: bool = False,
         obs_slots: int = 0,
         sort_free: bool = None,
+        deferred: bool = None,
     ) -> PoolEntry:
         """Warm plain engine for (model meaning, geometry) - keyed on
         the struct-cache memo key, so pool identity == memo identity."""
@@ -227,13 +229,14 @@ class EnginePool:
             model, chunk, queue_capacity, fp_capacity, fp_index, seed,
             DEFAULT_FP_HIGHWATER, check_deadlock=check_deadlock,
             pipeline=pipeline, obs_slots=obs_slots, sort_free=sort_free,
+            deferred=deferred,
         )
         return self._get_or_build(
             key,
             lambda: _SingleRunner(
                 model, chunk, queue_capacity, fp_capacity, fp_index,
                 seed, check_deadlock, pipeline, obs_slots,
-                sort_free=sort_free,
+                sort_free=sort_free, deferred=deferred,
             ),
             "single",
             dict(workload=model.root_name, chunk=chunk,
@@ -251,15 +254,17 @@ class EnginePool:
         seed: int = DEFAULT_SEED,
         check_deadlock: bool = True,
         sort_free: bool = None,
+        deferred: bool = None,
     ) -> PoolEntry:
         """Warm constants-class sweep engine: one entry per CLASS (the
         swept values are runtime data, not key material)."""
-        from ..engine.bfs import resolve_sort_free
+        from ..engine.bfs import resolve_deferred, resolve_sort_free
         from .sweep import SweepEngine, class_key
 
         key = ("sweep", class_key(model, params), chunk, queue_capacity,
                fp_capacity, fp_index, seed, bool(check_deadlock),
-               int(self.sweep_width), resolve_sort_free(sort_free, chunk))
+               int(self.sweep_width), resolve_sort_free(sort_free, chunk),
+               resolve_deferred(deferred, chunk))
         return self._get_or_build(
             key,
             lambda: SweepEngine(
@@ -267,7 +272,7 @@ class EnginePool:
                 queue_capacity=queue_capacity, fp_capacity=fp_capacity,
                 fp_index=fp_index, seed=seed,
                 check_deadlock=check_deadlock, width=self.sweep_width,
-                sort_free=sort_free,
+                sort_free=sort_free, deferred=deferred,
             ),
             "sweep",
             dict(workload=model.root_name, chunk=chunk,

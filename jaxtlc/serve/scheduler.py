@@ -65,7 +65,8 @@ DEFAULT_SIM_DEPTH = 64
 # job options forwarded to api.CheckRequest on the supervised path
 _REQUEST_OPTIONS = (
     "workers", "frontend", "chunk", "qcap", "fpcap", "pipeline",
-    "sortfree", "sharded", "checkpoint", "recover", "liveness",
+    "sortfree", "deferredinv", "sharded", "checkpoint", "recover",
+    "liveness",
     "fairness", "nodeadlock", "faults", "retry", "maxregrow", "spill",
     "obs", "obsslots", "coverage", "recheck", "noartifactcache",
     "simulate", "depth", "walkers", "simseed",
@@ -343,6 +344,7 @@ class Scheduler:
             fp_capacity=int(o.get("fpcap", DEFAULT_FPCAP)),
             check_deadlock=not o.get("nodeadlock", False),
             sort_free=o.get("sortfree", None),
+            deferred=o.get("deferredinv", None),
         )
 
     def _run_sweep(self, batch: List[Job]) -> None:

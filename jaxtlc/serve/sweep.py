@@ -229,6 +229,7 @@ class SweepEngine:
         check_deadlock: bool = True,
         width: int = DEFAULT_WIDTH,
         sort_free: bool = None,
+        deferred: bool = None,
     ):
         from ..struct.cache import enable_persistent_cache
 
@@ -249,7 +250,7 @@ class SweepEngine:
         init_fn, run_fn, _ = make_backend_engine(
             self.backend, chunk, queue_capacity, fp_capacity,
             fp_index, seed, check_deadlock=check_deadlock, donate=False,
-            sort_free=sort_free,
+            sort_free=sort_free, deferred=deferred,
         )
         # jitted seeding: an eager init_fn recompiles its fpset
         # while_loop per call; under jit the (per-Init-set-shape)

@@ -218,24 +218,27 @@ def engine_key(
     bounds=None,
     coverage: bool = False,
     sort_free: bool = None,
+    deferred: bool = None,
 ) -> tuple:
     """The full engine-memo key: spec meaning (digest + canonical
     constants + invariants) x engine geometry x pipeline/obs/coverage/
     sort-free flags x the certified-bound digest (a narrowed engine is
     a DIFFERENT compile - its codec, lanes and traps all change with
     the bounds; a covered engine carries the coverage leaves; a
-    sort-free engine compiles the hash-slab commit).  The serve
-    EnginePool keys its warm AOT entries on exactly this tuple so pool
-    identity and memo identity cannot drift.  `sort_free` is resolved
-    (tri-state auto -> bool) against the chunk so the key never
-    depends on who asked."""
-    from ..engine.bfs import resolve_sort_free
+    sort-free engine compiles the hash-slab commit; a deferred
+    engine moves invariant/cert evaluation to the commit stage, ISSUE
+    15).  The serve EnginePool keys its warm AOT entries on exactly
+    this tuple so pool identity and memo identity cannot drift.
+    `sort_free` and `deferred` are resolved (tri-state auto -> bool)
+    against the chunk so the key never depends on who asked."""
+    from ..engine.bfs import resolve_deferred, resolve_sort_free
 
     return (
         model_key(model), "single", chunk, queue_capacity, fp_capacity,
         fp_index, seed, fp_highwater, bool(check_deadlock),
         bool(pipeline), int(obs_slots), _bounds_key(bounds),
         bool(coverage), resolve_sort_free(sort_free, chunk),
+        resolve_deferred(deferred, chunk),
     )
 
 
@@ -253,6 +256,7 @@ def get_engine(
     bounds=None,
     coverage: bool = False,
     sort_free: bool = None,
+    deferred: bool = None,
 ) -> Tuple:
     """Memoized single-device engine triple (init_fn, run_fn, step_fn)
     for a struct model; enables the persistent XLA cache as a side
@@ -271,7 +275,7 @@ def get_engine(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
         obs_slots=obs_slots, bounds=bounds, coverage=coverage,
-        sort_free=sort_free,
+        sort_free=sort_free, deferred=deferred,
     )
     hit = _ENGINE_MEMO.get(key)
     if hit is None:
@@ -281,6 +285,7 @@ def get_engine(
             backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
             fp_highwater=fp_highwater, pipeline=pipeline,
             obs_slots=obs_slots, sort_free=sort_free,
+            deferred=deferred,
         )
         _ENGINE_MEMO.put(key, hit)
     return hit

@@ -56,6 +56,7 @@ def check_struct(
     bounds=None,
     coverage: bool = False,
     sort_free: bool = None,
+    deferred: bool = None,
     capture_fps: bool = False,
 ) -> CheckResult:
     """Exhaustive device check of a struct-compiled spec (single device,
@@ -71,7 +72,7 @@ def check_struct(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
         obs_slots=obs_slots, bounds=bounds, coverage=coverage,
-        sort_free=sort_free,
+        sort_free=sort_free, deferred=deferred,
     )
     backend = get_backend(model, check_deadlock, bounds=bounds,
                           coverage=coverage)
@@ -107,6 +108,7 @@ def check_struct_sharded(
     bounds=None,
     coverage: bool = False,
     sort_free: bool = None,
+    deferred: bool = None,
 ) -> CheckResult:
     """Exhaustive mesh-sharded check of a struct-compiled spec
     (capacities PER DEVICE; fingerprint-space all_to_all partitioning,
@@ -123,5 +125,5 @@ def check_struct_sharded(
         None, mesh, chunk=chunk, queue_capacity=queue_capacity,
         fp_capacity=fp_capacity, route_factor=route_factor,
         backend=backend, pipeline=pipeline, obs_slots=obs_slots,
-        sort_free=sort_free,
+        sort_free=sort_free, deferred=deferred,
     )

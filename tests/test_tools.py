@@ -124,15 +124,27 @@ def test_committed_costmodel_document():
     assert doc["ms_per_step_sort_free"]["sort"][big] <= (
         doc["ms_per_step"]["sort"][big] / 2.0
     )
+    # v3 (ISSUE 15): the deferred-evaluation columns ride the same
+    # document, and the committed `inv` subphase at the largest chunk
+    # is >= 2x cheaper under deferred evaluation than immediate (the
+    # distinct-first acceptance relation)
+    assert doc["ms_per_step_deferred"]["inv"][big] <= (
+        doc["ms_per_step_sort_free"]["inv"][big] / 2.0
+    )
     for p in mod.PHASES:
         assert "a_ms" in doc["fit_sort_free"][p], p
+        assert "a_ms" in doc["fit_deferred"][p], p
         # v2 clamps: no fitted slope may be negative (the r11 enqueue
-        # column's -1.32 is the regression this guards)
-        assert doc["fit"][p]["b_ms_per_1k"] >= 0, p
-        assert doc["fit_sort_free"][p]["b_ms_per_1k"] >= 0, p
+        # column's -1.32 is the regression this guards); v3 extends
+        # the same physicality rule to intercepts (the v2 sort
+        # a_ms = -0.4441 is the regression THAT guards)
+        for table in ("fit", "fit_sort_free", "fit_deferred"):
+            assert doc[table][p]["b_ms_per_1k"] >= 0, (table, p)
+            assert doc[table][p]["a_ms"] >= 0, (table, p)
     # and the table renderer accepts the committed document
     assert "| chunk |" in mod.perf_table(doc)
     assert "sort-free commit" in mod.perf_table(doc)
+    assert "deferred evaluation" in mod.perf_table(doc)
 
 
 def test_loadgen_tiny_smoke(capsys):
@@ -238,6 +250,9 @@ def test_bench_emit_enforces_payload_contract(capsys):
         # ISSUE 14: which SEARCH produced the number (exhaustive BFS
         # vs the random-walk simulation tier) rides every payload too
         assert "sim" in payload
+        # ISSUE 15: which EXPAND mode produced the number (immediate
+        # per-candidate vs distinct-first deferred inv/cert) too
+        assert "deferred" in payload
     # both emissions were journaled as validated bench_metric events
     kinds = [e["event"] for e in bench._JOURNAL.events]
     assert kinds.count("bench_metric") == 2

@@ -40,10 +40,18 @@ sys.path.insert(
 # sort-free commit columns (ms_per_step_sort_free / fit_sort_free: the
 # same sweep measured with the hash-slab dedup) so the before/after of
 # the ROADMAP #1 commit rewrite lives in one committed document.
-COSTMODEL_VERSION = 2
+#
+# v3 (ISSUE 15): the v2 `inv_fp` wall splits into separate `inv` and
+# `fp` columns (the fit could not see which half dominated - it was
+# the invariant sweep), a deferred-evaluation sweep rides the same
+# document (ms_per_step_deferred / fit_deferred: sort-free commit +
+# the commit-site claimant checker), and NEGATIVE INTERCEPTS are
+# clamped the way v2 clamped negative slopes (the v2 document carried
+# sort a_ms = -0.4441 - a step cannot have negative fixed cost).
+COSTMODEL_VERSION = 3
 
 # the phase columns of the emitted table, in pipeline order
-PHASES = ("kernel", "inv_fp", "expand", "sort", "probe", "enqueue",
+PHASES = ("kernel", "inv", "fp", "expand", "sort", "probe", "enqueue",
           "commit", "step")
 
 
@@ -113,7 +121,14 @@ def fit_linear(chunks, ms_values) -> dict:
     the chunk grows, so a negative fitted slope is measurement noise
     through an amortized-to-zero phase (the r11 document's enqueue
     column fitted b = -1.32 ms/1k).  A clamped fit refits at b = 0
-    (a = mean) and records `clamped: true`; the table marks it."""
+    (a = mean) and records `clamped: true`; the table marks it.
+
+    Intercepts are clamped the same way (v3): a phase cannot have
+    negative fixed cost, so a negative fitted intercept (the v2
+    document's sort a_ms = -0.4441) is noise through a slope-dominated
+    phase.  The refit goes through the origin (b = sum(xy)/sum(x^2),
+    nonnegative since all measurements are) and records
+    `clamped_intercept: true`; the table marks it too."""
     import numpy as np
 
     x = np.asarray(chunks, float)
@@ -123,8 +138,13 @@ def fit_linear(chunks, ms_values) -> dict:
                 "r2": 1.0}
     b, a = np.polyfit(x, y, 1)
     clamped = b < 0
+    clamped_icpt = False
     if clamped:
         b, a = 0.0, float(y.mean())
+    elif a < 0:
+        clamped_icpt = True
+        a = 0.0
+        b = float((x * y).sum() / (x * x).sum())
     pred = a + b * x
     ss_res = float(((y - pred) ** 2).sum())
     ss_tot = float(((y - y.mean()) ** 2).sum())
@@ -134,14 +154,16 @@ def fit_linear(chunks, ms_values) -> dict:
            "r2": round(r2, 4)}
     if clamped:
         out["clamped"] = True
+    if clamped_icpt:
+        out["clamped_intercept"] = True
     return out
 
 
 def real_measure(backend, qcap: int, fpcap: int, warm: int, K: int,
                  reps: int, phased_steps: int):
     """measure(chunk) over the real engines: differential sub-phase
-    walls (sorted AND sort-free commit) + phase-event walls + the
-    pipelined step."""
+    walls (sorted, sort-free, and sort-free + deferred-evaluation
+    commit) + phase-event walls + the pipelined step."""
     from jaxtlc.obs.phases import subphase_walls
 
     def measure(chunk):
@@ -153,11 +175,15 @@ def real_measure(backend, qcap: int, fpcap: int, warm: int, K: int,
             backend, chunk, qcap, fpcap, warm_steps=warm, K=K,
             reps=reps, sort_free=True,
         )
+        walls_def = subphase_walls(
+            backend, chunk, qcap, fpcap, warm_steps=warm, K=K,
+            reps=reps, sort_free=True, deferred=True,
+        )
         ev = _phase_event_walls(backend, chunk, qcap, fpcap,
                                 phased_steps)
         pipe = _pipelined_step_ms(backend, chunk, qcap, fpcap, warm,
                                   K, reps)
-        return walls, ev, pipe, walls_sf
+        return walls, ev, pipe, walls_sf, walls_def
 
     return measure
 
@@ -167,7 +193,8 @@ def real_measure(backend, qcap: int, fpcap: int, warm: int, K: int,
 # RECOVERS them - a real correctness check of the fit path with zero
 # engine compiles (tier-1 runs at ~800 s of its 870 s budget; the real
 # measurement path is exercised by the committed COSTMODEL.json run)
-_SYNTH = {"kernel": (0.5, 0.004), "inv_fp": (0.1, 0.001),
+_SYNTH = {"kernel": (0.5, 0.004), "inv": (0.06, 0.0006),
+          "fp": (0.04, 0.0004),
           "expand": (0.6, 0.005), "sort": (0.05, 0.002),
           "probe": (0.1, 0.0015), "enqueue": (0.15, 0.0005),
           "commit": (0.3, 0.004), "step": (0.9, 0.009)}
@@ -180,14 +207,28 @@ _SYNTH_SF.update({"sort": (0.0125, 0.0005),
                   "commit": (0.2625, 0.0025),
                   "step": (0.8625, 0.0075)})
 
+# the synthetic deferred walls (v3): the inv column shrinks 4x (the
+# distinct-first collapse), expand loses that saving, commit absorbs
+# the claimant checker - also exactly linear, so the tiny smoke
+# asserts the fit_deferred table recovers planted coefficients AND
+# the >= 2x inv relation the committed-document test reads off the
+# real sweep
+_SYNTH_DEF = dict(_SYNTH_SF)
+_SYNTH_DEF.update({"inv": (0.015, 0.00015),
+                   "expand": (0.555, 0.00455),
+                   "commit": (0.2775, 0.002875),
+                   "step": (0.8325, 0.007425)})
+
 
 def synthetic_measure(chunk):
     walls = {p: (a + b * chunk) / 1e3 for p, (a, b) in _SYNTH.items()}
     walls_sf = {p: (a + b * chunk) / 1e3
                 for p, (a, b) in _SYNTH_SF.items()}
+    walls_def = {p: (a + b * chunk) / 1e3
+                 for p, (a, b) in _SYNTH_DEF.items()}
     ev = {"expand_ms": 1e3 * walls["expand"],
           "commit_ms": 1e3 * walls["commit"], "bodies": 8}
-    return walls, ev, 1e3 * walls["step"] * 0.9, walls_sf
+    return walls, ev, 1e3 * walls["step"] * 0.9, walls_sf, walls_def
 
 
 def sweep(workload: str, chunks, geometry: dict, measure) -> dict:
@@ -198,30 +239,38 @@ def sweep(workload: str, chunks, geometry: dict, measure) -> dict:
 
     ms = {p: {} for p in PHASES}
     ms_sf = {p: {} for p in PHASES}
+    ms_def = {p: {} for p in PHASES}
     events_ms = {"expand": {}, "commit": {}}
     pipe_ms = {}
     for chunk in chunks:
         t0 = time.time()
-        walls, ev, pipe, walls_sf = measure(chunk)
+        walls, ev, pipe, walls_sf, walls_def = measure(chunk)
         for p in PHASES:
             ms[p][str(chunk)] = round(1e3 * walls[p], 4)
             ms_sf[p][str(chunk)] = round(1e3 * walls_sf[p], 4)
+            ms_def[p][str(chunk)] = round(1e3 * walls_def[p], 4)
         events_ms["expand"][str(chunk)] = round(ev["expand_ms"], 4)
         events_ms["commit"][str(chunk)] = round(ev["commit_ms"], 4)
         pipe_ms[str(chunk)] = round(pipe, 4)
         print(f"  chunk {chunk}: step {ms['step'][str(chunk)]:.3f} ms "
               f"(expand {ms['expand'][str(chunk)]:.3f} / commit "
-              f"{ms['commit'][str(chunk)]:.3f}; sort "
+              f"{ms['commit'][str(chunk)]:.3f}; inv "
+              f"{ms['inv'][str(chunk)]:.3f} sort "
               f"{ms['sort'][str(chunk)]:.3f} probe "
               f"{ms['probe'][str(chunk)]:.3f} enqueue "
               f"{ms['enqueue'][str(chunk)]:.3f}) "
               f"sort-free dedup {ms_sf['sort'][str(chunk)]:.3f} ms "
+              f"deferred inv {ms_def['inv'][str(chunk)]:.3f} ms "
+              f"(step {ms_def['step'][str(chunk)]:.3f}) "
               f"pipelined {pipe_ms[str(chunk)]:.3f} ms "
               f"[{time.time() - t0:.1f}s]", file=sys.stderr)
     fits = {p: fit_linear(chunks, [ms[p][str(c)] for c in chunks])
             for p in PHASES}
     fits_sf = {p: fit_linear(chunks, [ms_sf[p][str(c)] for c in chunks])
                for p in PHASES}
+    fits_def = {p: fit_linear(chunks,
+                              [ms_def[p][str(c)] for c in chunks])
+                for p in PHASES}
     return {
         "version": COSTMODEL_VERSION,
         "workload": workload,
@@ -234,6 +283,10 @@ def sweep(workload: str, chunks, geometry: dict, measure) -> dict:
         # the same sweep with the sort-free hash-slab commit (ISSUE 12;
         # the "sort" column is then the slab dedup stage)
         "ms_per_step_sort_free": ms_sf,
+        # the same sweep with sort-free commit AND deferred
+        # invariant/cert evaluation (ISSUE 15; the "inv" column is
+        # then the commit-site fresh-claimant checker)
+        "ms_per_step_deferred": ms_def,
         # measured walls decoded from `phase` journal events (the
         # PhasedRuntime path a live -phase-timing run journals)
         "phase_event_ms_per_step": events_ms,
@@ -243,6 +296,7 @@ def sweep(workload: str, chunks, geometry: dict, measure) -> dict:
         # (`clamped: true` marks a refit)
         "fit": fits,
         "fit_sort_free": fits_sf,
+        "fit_deferred": fits_def,
     }
 
 
@@ -251,7 +305,8 @@ def _fit_line(fits: dict, label: str) -> str:
             + "  ".join(
                 f"{p} {fits[p]['a_ms']:+.3f}{fits[p]['b_ms_per_1k']:+.3f}/1k"
                 + ("*" if fits[p].get("clamped") else "")
-                for p in ("expand", "sort", "probe", "enqueue",
+                + ("^" if fits[p].get("clamped_intercept") else "")
+                for p in ("inv", "expand", "sort", "probe", "enqueue",
                           "commit")
             ))
 
@@ -279,17 +334,40 @@ def perf_table(doc: dict) -> str:
             cells = [f"{ms_sf[p][str(c)]:.3f}" for p in PHASES]
             cells.append(f"{doc['pipelined_step_ms'][str(c)]:.3f}")
             rows.append(f"| {c} | " + " | ".join(cells) + " |")
+    ms_def = doc.get("ms_per_step_deferred")
+    if ms_def:
+        rows.append("")
+        rows.append("deferred evaluation (sort-free + distinct-first "
+                    "inv/cert, same sweep):")
+        rows.append(head)
+        rows.append(sep)
+        for c in chunks:
+            cells = [f"{ms_def[p][str(c)]:.3f}" for p in PHASES]
+            cells.append(f"{doc['pipelined_step_ms'][str(c)]:.3f}")
+            rows.append(f"| {c} | " + " | ".join(cells) + " |")
     rows.append("")
     rows.append(_fit_line(doc["fit"], "sorted"))
     if doc.get("fit_sort_free"):
         rows.append(_fit_line(doc["fit_sort_free"], "sort-free"))
-    clamped = [p for p in PHASES if doc["fit"][p].get("clamped")] + [
-        f"{p} (sort-free)" for p in PHASES
-        if doc.get("fit_sort_free", {}).get(p, {}).get("clamped")
+    if doc.get("fit_deferred"):
+        rows.append(_fit_line(doc["fit_deferred"], "deferred"))
+    tables = (("", "fit"), (" (sort-free)", "fit_sort_free"),
+              (" (deferred)", "fit_deferred"))
+    clamped = [
+        f"{p}{suffix}" for suffix, key in tables for p in PHASES
+        if doc.get(key, {}).get(p, {}).get("clamped")
+    ]
+    clamped_icpt = [
+        f"{p}{suffix}" for suffix, key in tables for p in PHASES
+        if doc.get(key, {}).get(p, {}).get("clamped_intercept")
     ]
     if clamped:
         rows.append("* slope clamped to 0 (raw least-squares slope was "
                     f"negative): {', '.join(clamped)}")
+    if clamped_icpt:
+        rows.append("^ intercept clamped to 0, refit through the "
+                    "origin (raw least-squares intercept was "
+                    f"negative): {', '.join(clamped_icpt)}")
     return "\n".join(rows) + "\n"
 
 
@@ -361,9 +439,10 @@ def main(argv=None) -> int:
         for p in PHASES:
             assert set(back["ms_per_step"][p]) == {str(c) for c in chunks}
             # the synthetic walls are exactly linear: the fitter must
-            # recover the planted coefficients - in both commit modes
+            # recover the planted coefficients - in all three modes
             for table, planted in (("fit", _SYNTH),
-                                   ("fit_sort_free", _SYNTH_SF)):
+                                   ("fit_sort_free", _SYNTH_SF),
+                                   ("fit_deferred", _SYNTH_DEF)):
                 a, b = planted[p]
                 fit = back[table][p]
                 assert abs(fit["a_ms"] - a) < 1e-2, (table, p, fit)
@@ -376,13 +455,26 @@ def main(argv=None) -> int:
         assert back["ms_per_step"]["sort"][big] >= 2 * (
             back["ms_per_step_sort_free"]["sort"][big]
         )
+        # v3: the planted deferred inv is 4x cheaper - the document
+        # must carry the >= 2x relation the ISSUE 15 acceptance gate
+        # reads off the real sweep
+        assert back["ms_per_step_deferred"]["inv"][big] <= (
+            back["ms_per_step_sort_free"]["inv"][big] / 2.0
+        )
         # a decreasing series must clamp to slope 0, loudly
         cl = fit_linear([64, 128, 256], [3.0, 2.0, 1.0])
         assert cl["b_ms_per_1k"] == 0.0 and cl.get("clamped"), cl
         assert abs(cl["a_ms"] - 2.0) < 1e-9, cl
+        # a negative-intercept series must clamp the intercept and
+        # refit through the origin, loudly (v3: the v2 document's
+        # sort a_ms = -0.4441 is the regression this guards)
+        ci = fit_linear([64, 128, 256], [2.2, 5.4, 11.8])  # 0.05x - 1
+        assert ci.get("clamped_intercept") and ci["a_ms"] == 0.0, ci
+        assert ci["b_ms_per_1k"] > 0, ci
         assert back["phase_event_ms_per_step"]["commit"]
         assert "| chunk |" in perf_table(back)
         assert "sort-free commit" in perf_table(back)
+        assert "deferred evaluation" in perf_table(back)
         os.unlink(args.out)
         print("costmodel tiny OK")
     else:

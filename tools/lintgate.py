@@ -26,15 +26,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # tests/test_analysis.py::test_selfcheck_registry_pinned); importing
 # the registry is jax-free, so this stays an engine-free gate
 REQUIRED_FACTORIES = (
-    "covered", "enumerator", "fused", "narrowed", "phased",
-    "pipelined", "sharded", "sim", "sortfree", "spill", "struct",
-    "sweep",
+    "covered", "deferred", "enumerator", "fused", "narrowed",
+    "phased", "pipelined", "sharded", "sim", "sortfree", "spill",
+    "struct", "sweep",
 )
 
 
 def check_factories() -> int:
     """Engine-free registry pin: every REQUIRED factory (the sort-free
-    commit engine included, ISSUE 12) must be registered for the
+    commit engine, ISSUE 12, and the deferred-evaluation engine,
+    ISSUE 15, included) must be registered for the
     `python -m jaxtlc.analysis --self-check` audit - a commit that
     drops one fails here before any engine builds."""
     from jaxtlc.analysis.selfcheck import FACTORIES
