@@ -59,6 +59,14 @@ Usage:
                                # both rates, full signature AND fpset
                                # TABLE words bit-equality gated (the
                                # ISSUE 15 exactness contract)
+    python bench.py --infer    # inference tier (ISSUE 16): the dense
+                               # [P, S] predicates x states filter
+                               # kernel over RaftElection evidence
+                               # tiled to a fixed state count, AOT
+                               # once, best-of-5; emits
+                               # predicate_evals_per_s with
+                               # vs_baseline = device rate over the
+                               # host ev.eval oracle rate
     python bench.py --sim      # simulation tier (ISSUE 14): Model_1
                                # random walks vs the chunk-matched BFS
                                # engine, both AOT once, interleaved
@@ -1178,9 +1186,97 @@ def bench_sim(probe_err: str) -> int:
     return 0
 
 
+def bench_infer(probe_err: str) -> int:
+    """--infer: the inference tier's filter throughput (ISSUE 16).
+
+    Builds the RaftElection inference engine once (candidate pool +
+    [P, S] filter kernel AOT-compiled against the fixed block shape),
+    tiles the exact reachable evidence to a fixed state count, and
+    times the dense predicates x states filter best-of-5: the emitted
+    `predicate_evals_per_s` line carries P*S/wall with vs_baseline =
+    device rate over the host `ev.eval` oracle rate (measured on a
+    sample - the same per-eval work, minus vmap).  One full inference
+    run beside it reports the funnel (candidates -> survivors ->
+    certified) and the certify wall so the end-to-end price is on the
+    line too."""
+    import os
+
+    import jax
+
+    if probe_err:
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from jaxtlc.infer.driver import InferEngine
+    from jaxtlc.infer.filter import filter_matrix, host_filter
+    from jaxtlc.struct.loader import load
+
+    specs = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "specs")
+    model = load(os.path.join(specs, "RaftElection.toolbox", "Model_1",
+                              "MC.cfg"))
+    eng = InferEngine(model, budget=64)
+    if eng.exact_fields is None:
+        _emit({"error": "RaftElection evidence is not exact (expected "
+                        "artifact or host-BFS reachable set)",
+               "infer": True})
+        return 1
+    rep = eng.run(seed=0)
+    P = len(eng.candidates)
+
+    # tile the evidence up so the timed region is kernel-bound, not
+    # pad-bound (the reachable set is small; the kernel does not care
+    # whether rows repeat)
+    reps = max(1, 200_000 // eng.exact_fields.shape[0])
+    fields = np.tile(eng.exact_fields, (reps, 1))
+    S = fields.shape[0]
+    filter_matrix(eng.filter_fn, fields)  # warm the dispatch path
+    walls = []
+    for _ in range(5):
+        t0 = time.time()
+        filter_matrix(eng.filter_fn, fields)
+        walls.append(time.time() - t0)
+    wall = min(walls)
+    evals_per_s = (P * S) / wall
+
+    # host oracle rate on a sample: the same P predicates through
+    # ev.eval, the reference the device matrix is pinned against
+    sample = [eng.backend.cdc.decode(v)
+              for v in eng.exact_fields[:256]]
+    t0 = time.time()
+    host_filter(model.system, eng.candidates, sample)
+    host_wall = time.time() - t0
+    host_evals_per_s = (P * len(sample)) / host_wall
+
+    _emit({
+        "metric": "predicate_evals_per_s",
+        "value": round(evals_per_s, 1),
+        "unit": "predicate-evals/s",
+        "vs_baseline": round(evals_per_s / host_evals_per_s, 1),
+        "infer": True,
+        "workload": "RaftElection",
+        "predicates": P,
+        "states": S,
+        "filter_wall_s": round(wall, 4),
+        "host_evals_per_s": round(host_evals_per_s, 1),
+        "evidence": rep.evidence,
+        "evidence_states": rep.n_states,
+        "survivors": len(rep.survivors),
+        "certified": len(rep.certified),
+        "certify_wall_s": round(rep.certify_wall_s, 4),
+        "device": str(jax.devices()[0]) + (
+            f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
+            if probe_err else ""
+        ),
+    })
+    return 0
+
+
 def main() -> int:
     device_note = ""
     probe_err = _probe_backend()
+    if "--infer" in sys.argv:
+        return bench_infer(probe_err)
     if "--sim" in sys.argv:
         return bench_sim(probe_err)
     if "--commit-ab" in sys.argv:

@@ -219,6 +219,43 @@ def _build_sim():
                 fp_capacity=1 << 10)
 
 
+def _build_infer():
+    # the inference filter/certify kernels (jaxtlc.infer, ISSUE 16):
+    # the same TwoPhase model as "struct", its conjectured candidate
+    # pool compiled into the [P, S] filter dispatch (run_fn) and the
+    # one-step closure certify dispatch (step_fn) - the vmapped
+    # stacked-predicate path cannot ship unaudited
+    import os
+
+    from ..infer.candidates import conjecture
+    from ..infer.certify import make_certify_fn
+    from ..infer.filter import (
+        compile_predicates,
+        make_filter_fn,
+        predicate_compiler,
+    )
+    from ..struct.cache import get_backend, get_bounds
+    from ..struct.loader import load
+
+    d = _specs_dir()
+    if d is None:
+        raise FileNotFoundError("specs/ directory not found")
+    model = load(os.path.join(d, "TwoPhase.toolbox", "Model_1",
+                              "MC.cfg"))
+    b = get_backend(model, True)
+    cands, _ = conjecture(model, bounds=get_bounds(model), budget=16)
+    fns, _ = compile_predicates(predicate_compiler(model, b), cands)
+
+    def init_fn():
+        import jax.numpy as jnp
+
+        return jnp.zeros((16, b.cdc.n_fields), jnp.int32)
+
+    return dict(init_fn=init_fn, run_fn=make_filter_fn(fns),
+                step_fn=make_certify_fn(b, fns), n_lanes=b.n_lanes,
+                fp_capacity=_TINY["fp_capacity"])
+
+
 def _build_enumerator():
     from ..engine.bfs import make_enumerator
 
@@ -330,6 +367,7 @@ FACTORIES: Dict[str, Callable[[], dict]] = {
     "covered": _build_covered,
     "deferred": _build_deferred,
     "fused": _build_fused,
+    "infer": _build_infer,
     "narrowed": _build_narrowed,
     "phased": _build_phased,
     "pipelined": _build_pipelined,

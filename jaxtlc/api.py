@@ -108,6 +108,14 @@ class CheckRequest:
     depth: int = 100
     walkers: int = 256
     simseed: int = 0
+    # invariant inference (jaxtlc.infer, ISSUE 16): -infer swaps
+    # checking for the conjecture -> filter -> certify loop - a third
+    # verdict class beside exhaustive and smoke.  Like sim it never
+    # publishes to the artifact-cache verdict tier (its verdict is
+    # about CANDIDATES, not the spec's stated invariants); unlike sim
+    # it READS the reachable-set artifact as exact filter evidence
+    infer: bool = False
+    inferbudget: int = 64
     # -- library-only knobs (no CLI flag) -------------------------------
     # MC.cfg-style constant overrides applied on top of the config's
     # baked values (the serve path: a job's constants must shape the
@@ -197,6 +205,17 @@ def _run_check(args) -> int:
         print("Error: -simulate requires the structural frontend "
               "(re-run with -frontend struct)", file=_err(args))
         return 1
+    if getattr(args, "infer", False):
+        if getattr(args, "simulate", False):
+            print("Error: -infer and -simulate are distinct job "
+                  "classes (pick one)", file=_err(args))
+            return 1
+        if not isinstance(spec, StructRunSpec):
+            # inference conjectures over the struct IR's shapes; like
+            # -simulate this is a spelling, not a capability, gap
+            print("Error: -infer requires the structural frontend "
+                  "(re-run with -frontend struct)", file=_err(args))
+            return 1
     if isinstance(spec, GenRunSpec):
         return _run_check_gen(args, spec)
     if isinstance(spec, StructRunSpec):
@@ -942,6 +961,10 @@ def _run_check_struct(args, spec) -> int:
         # the simulation tier (jaxtlc.sim, ISSUE 14): random-walk
         # smoke checking instead of exhaustive BFS
         return _run_sim_struct(args, spec)
+    if getattr(args, "infer", False):
+        # invariant inference (jaxtlc.infer, ISSUE 16): conjecture ->
+        # filter -> certify instead of checking
+        return _run_infer_struct(args, spec)
     log_holder = []
 
     # -narrow: the certified-bound narrowed codec (analysis.absint).
@@ -1161,12 +1184,20 @@ def _run_sim_struct(args, spec) -> int:
         return rc
     log.msg(1000, f"Running random simulation: {args.walkers} walks "
                   f"to depth {args.depth} (seed {args.simseed}).")
+    from .sim.liveness import expressible as _live_expressible
+
+    live_props = []
     for name in spec.properties:
-        # cfg-declared temporal properties: walks check invariants and
-        # deadlock only (TLC's simulate has the same blind spot)
-        log.msg(1000, f"Temporal property {name} skipped: simulation "
-                      "checks invariants and deadlock on sampled "
-                      "behaviors only.", severity=1)
+        # cfg-declared temporal properties: plain P ~> Q is checked on
+        # the sampled behaviors after the walk (lasso detection, TLC's
+        # -simulate analog); shapes the trace checker cannot express
+        # keep the skip notice
+        skip = _live_expressible(sm.properties[name])
+        if skip is not None:
+            log.msg(1000, f"Temporal property {name} skipped: {skip}.",
+                    severity=1)
+        else:
+            live_props.append(name)
     t0 = time.time()
     resume_cmd = _resume_command(args)
 
@@ -1258,6 +1289,47 @@ def _run_sim_struct(args, spec) -> int:
         log.msg(1000, "No violation found in the sampled behaviors "
                       "(simulation is NOT exhaustive - this is a "
                       "smoke verdict).")
+    liveness_violated = False
+    if not violated and live_props:
+        # liveness on the sampled traces (ISSUE 16 satellite): lasso
+        # detection over the walk trajectories, re-derived from the
+        # seed (a lane is a pure function of (seed, lane) - the same
+        # replay guarantee the safety trace uses)
+        from .sim.liveness import check_walk_leads_to, walk_trajectories
+
+        trajs = walk_trajectories(
+            sm, args.walkers, args.depth, args.simseed,
+            check_deadlock=spec.check_deadlock,
+        )
+        for name in live_props:
+            ast = sm.properties[name]
+            res = check_walk_leads_to(sm, ast[1], ast[2], name, trajs)
+            if j is not None:
+                j.event("sim", phase="liveness", walkers=args.walkers,
+                        depth=args.depth, steps=r.steps,
+                        transitions=r.transitions, property=name,
+                        lassos=res.lassos, holds=res.holds)
+            if res.holds:
+                log.msg(1000, f"Temporal property {name}: no "
+                              f"violating lasso in the sampled "
+                              f"behaviors ({res.lassos} lasso(s) "
+                              f"examined; sampling is NOT "
+                              f"exhaustive).")
+                continue
+            liveness_violated = True
+            log.msg(2116, f"Temporal properties were violated: {name}",
+                    severity=1)
+            idx = 1
+            for st in res.prefix:
+                log.trace_state(idx, None,
+                                so.state_to_tla(sm.system, st))
+                idx += 1
+            log.msg(1000, "-- The following states form a cycle "
+                          "(back to the first of them) --")
+            for st in res.cycle:
+                log.trace_state(idx, None,
+                                so.state_to_tla(sm.system, st))
+                idx += 1
     log.progress(r.steps, r.generated, r.distinct, 0)
     log.final_counts(r.generated, r.distinct, 0)
     log.finished(int((time.time() - t0) * 1000))
@@ -1265,10 +1337,174 @@ def _run_sim_struct(args, spec) -> int:
         if violated:
             j.event("violation", code=int(r.violation),
                     name=r.violation_name)
+        elif liveness_violated:
+            j.event("violation", code=13,
+                    name="Temporal properties were violated")
         j.event("final",
-                verdict="violation" if violated else "ok",
+                verdict=("violation" if violated else
+                         "liveness_violation" if liveness_violated
+                         else "ok"),
                 generated=r.generated, distinct=r.distinct,
                 depth=r.steps, queue=0,
+                wall_s=round(time.time() - t0, 6), interrupted=False)
+    _finish_journal(args, log)
+    if violated:
+        return 12
+    return 13 if liveness_violated else 0
+
+
+def _run_infer_struct(args, spec) -> int:
+    """The inference job class (jaxtlc.infer, ISSUE 16): conjecture
+    candidate invariants over the struct IR, kill the ones reachable
+    evidence refutes in vmapped [P, S] filter dispatches, certify the
+    survivors inductive - the same banner/journal/preflight plumbing
+    as a check, but the product is a transcript of CERTIFIED candidate
+    invariants (and an honest "consistent with evidence only" list),
+    not a pass/fail verdict about the spec.  The run exits 12 only
+    when EXACT evidence kills a cfg-named invariant - a real reachable
+    violation - and never publishes to the artifact-cache verdict
+    tier."""
+    from .infer.driver import run_infer
+    from .struct import artifacts as _arts
+
+    sm = spec.structmodel
+    unsupported = [
+        flag for flag, on in (
+            ("-sharded", args.sharded),
+            ("-pipeline", args.pipeline),
+            ("-liveness", args.liveness),
+            ("-coverage", args.coverage),
+            ("-narrow", args.narrow),
+            ("-phase-timing", args.phasetiming),
+            ("-mutation", args.mutation),
+            ("-checkpoint", args.checkpoint),
+            ("-recover", args.recover),
+            ("-faults", args.faults),
+            ("-fpset DiskFPSet", args.fpset != "JaxFPSet"),
+        ) if on
+    ]
+    if unsupported:
+        print(
+            f"Error: {', '.join(unsupported)} not supported with "
+            "-infer (inference carries no frontier/checkpoint "
+            "machinery)",
+            file=_err(args),
+        )
+        return 1
+    log = TLCLog(out=args.out, tool_mode=not args.noTool)
+    import jax
+
+    device = str(jax.devices()[0])
+    log.version(__version__)
+    log.banner(spec.fp_index, DEFAULT_SEED, spec.workers, device)
+    log.sany(*_sany_inputs(args.config, spec.spec_name))
+    log.starting()
+    log.computing_init()
+    _open_journal(
+        args, workload=spec.spec_name, engine="infer", device=device,
+        params=dict(budget=args.inferbudget, walkers=args.walkers,
+                    depth=args.depth, sim_seed=args.simseed,
+                    frontend="struct"),
+    )
+    j = getattr(args, "_journal", None)
+    # artifact-cache honesty: inference READS the reachable-set tier
+    # as filter evidence but its verdict is about candidates, not the
+    # stated invariants - it never publishes to the verdict tier
+    if _arts.store_for(args) is not None and j is not None:
+        j.event("cache", tier="verdict", outcome="bypass", key="",
+                reason="inference verdicts are about candidate "
+                       "invariants and never publish")
+    rc = _preflight_gate(
+        args, log, lambda deep: _struct_preflight(args, spec, sm, deep)
+    )
+    if rc is not None:
+        return rc
+    log.msg(1000, f"Running invariant inference: budget "
+                  f"{args.inferbudget} candidates "
+                  f"(walk geometry {args.walkers}x{args.depth}, "
+                  f"seed {args.simseed}).")
+    t0 = time.time()
+    resume_cmd = _resume_command(args)
+
+    def on_event(kind, info):
+        if j is not None:
+            ev = j.event(kind, **info)
+        else:
+            from .obs.schema import SCHEMA_VERSION
+
+            ev = {"v": SCHEMA_VERSION, "t": time.time(),
+                  "event": kind, **info}
+        from .obs.views import render_tlc_event
+
+        render_tlc_event(log, ev, resume_cmd=resume_cmd)
+
+    running = {"killed": 0}
+
+    def on_round(row):
+        running["killed"] += row["killed"]
+        on_event("infer", dict(
+            phase="round",
+            candidates=row["survivors"] + running["killed"],
+            killed=running["killed"], survivors=row["survivors"],
+            certified=0, round=row["round"],
+            evidence=row["evidence"], n_states=row["n_states"],
+        ))
+
+    try:
+        rep = run_infer(
+            sm, budget=args.inferbudget, walkers=args.walkers,
+            depth=args.depth, seed=args.simseed,
+            check_deadlock=spec.check_deadlock, on_round=on_round,
+        )
+    except (FileNotFoundError, ValueError) as e:
+        print(f"Error: {e}", file=_err(args))
+        _finish_journal(args, log)
+        return 1
+    args._result = rep
+    log.init_done(len(sm.system.initial_states()))
+    on_event("infer", dict(
+        phase="summary", candidates=rep.candidates, killed=rep.killed,
+        survivors=len(rep.survivors), certified=len(rep.certified),
+        certified_names=[c.name for c in rep.certified],
+        evidence=rep.evidence, n_states=rep.n_states,
+        dropped=rep.dropped,
+    ))
+    violated = bool(rep.cfg_killed)
+    if violated:
+        for name in rep.cfg_killed:
+            log.msg(2110, f"Invariant {name} is violated (refuted by "
+                          f"a reachable state in the exact evidence "
+                          f"set).", severity=1)
+    evid = (f"exact {rep.evidence} evidence ({rep.n_states} states)"
+            if rep.exact else
+            f"sampled walk evidence ({rep.n_states} states - "
+            f"NOT exhaustive)")
+    log.msg(1000, f"Inference complete: {rep.candidates} candidates "
+                  f"({rep.dropped} beyond budget), {rep.killed} killed "
+                  f"by {evid}.")
+    for c, basis in zip(rep.certified, rep.cert_basis):
+        line = c.name if c.source == "cfg" else f"{c.name} == {c.text}"
+        log.msg(1000, f"Certified inductive invariant [{basis}]: "
+                      f"{line}")
+    uncert = [c for c in rep.survivors if c not in rep.certified]
+    for c in uncert:
+        line = c.name if c.source == "cfg" else f"{c.name} == {c.text}"
+        log.msg(1000, f"Consistent with evidence only (NOT certified): "
+                      f"{line}", severity=1)
+    for name in rep.uncompiled:
+        log.msg(1000, f"Candidate {name} skipped: outside the lane-"
+                      f"compilable subset.", severity=1)
+    log.progress(0, rep.n_states, rep.n_states, 0)
+    log.final_counts(rep.n_states, rep.n_states, 0)
+    log.finished(int((time.time() - t0) * 1000))
+    if j is not None:
+        if violated:
+            j.event("violation", code=100,
+                    name=f"Invariant {rep.cfg_killed[0]} is violated.")
+        j.event("final",
+                verdict="violation" if violated else "ok",
+                generated=rep.n_states, distinct=rep.n_states,
+                depth=0, queue=0,
                 wall_s=round(time.time() - t0, 6), interrupted=False)
     _finish_journal(args, log)
     return 12 if violated else 0
@@ -1282,11 +1518,13 @@ def _artifact_plan(args, spec, sm, bounds):
     (or JAXTLC_ARTIFACT_CACHE=off) disables the store outright."""
     if (args.recover or args.faults or args.mutation or args.coverage
             or args.phasetiming or args.xprof
-            or getattr(args, "simulate", False)):
-        # simulate is unreachable here (the sim path branches off
-        # before plans are built) but stays on the list as defense in
-        # depth: a simulation verdict is from INCOMPLETE search and
-        # must never publish to the verdict tier
+            or getattr(args, "simulate", False)
+            or getattr(args, "infer", False)):
+        # simulate/infer are unreachable here (both paths branch off
+        # before plans are built) but stay on the list as defense in
+        # depth: a simulation verdict is from INCOMPLETE search, an
+        # inference verdict is about CANDIDATES - neither may publish
+        # to the verdict tier
         return None
     from .struct import artifacts as _arts
 

@@ -343,6 +343,27 @@ def main(argv=None) -> int:
                    help="simulation run seed: every walk trajectory "
                         "(and any violation it finds) is an exact "
                         "pure function of this value")
+    c.add_argument("-infer", action="store_true",
+                   help="inductive invariant inference instead of "
+                        "checking (jaxtlc.infer): conjecture up to "
+                        "-infer-budget candidate predicates over the "
+                        "spec's shapes, kill the ones reachable "
+                        "evidence refutes in one vmapped "
+                        "predicates-x-states device kernel, certify "
+                        "the survivors inductive over the reachable "
+                        "set's one-step successors.  Exact evidence "
+                        "comes from the reachable-set artifact or a "
+                        "host BFS; intractable configs sample "
+                        "-walkers x -depth walk states (survivors are "
+                        "then 'consistent with evidence only').  "
+                        "Exits 12 only when exact evidence refutes a "
+                        "cfg-named invariant; requires -frontend "
+                        "struct")
+    c.add_argument("-infer-budget", dest="inferbudget", type=int,
+                   default=64,
+                   help="candidate pool cap for -infer (conjectures "
+                        "beyond it are counted as dropped in the "
+                        "journal)")
     c.add_argument("-liveness", action="store_true",
                    help="check the declared temporal properties even when "
                         "the launch config disables them (E8); above "

@@ -1,0 +1,197 @@
+"""Evidence filtering: the dense [P, S] predicates x states kernel.
+
+Each candidate compiles to a lane function through the SAME
+LaneCompiler path cfg invariants use (struct.compile.build_invariant),
+then ONE jitted dispatch evaluates all P candidates over a block of S
+evidence states under `vmap` - the whole counterexample-filter loop is
+a [P, S] boolean matrix product away from its kill decisions
+(`alive = matrix.all(axis=states)`).
+
+Evidence comes from three sources, strongest first:
+
+* **artifact**: a PR 13 reachable-set artifact (GF(2)-inverted from a
+  clean exhaustive run's fpset) - exact: any reachable refutation
+  kills the candidate.
+* **bfs**: a host-oracle BFS when the state space fits a budget -
+  exact, and also the reference the device filter is pinned against.
+* **sim**: PR 14 random-walk lane states streamed out of the sim
+  engine's step function instead of discarded - SAMPLED evidence for
+  intractable configs; kills remain sound (every sampled state is
+  reachable) but survival proves consistency only.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+FILTER_BLOCK = 2048
+# host-BFS evidence budget: below this many distinct states the exact
+# reachable set is computed fresh when no artifact is stored
+DEFAULT_MAX_HOST_STATES = 50_000
+
+
+def predicate_compiler(model, backend):
+    """A LaneCompiler sharing the backend's codec: the same
+    parse -> shape-infer pipeline the (un-narrowed) struct backend ran,
+    so candidate lane functions decode the backend's field vectors
+    bit-compatibly."""
+    from ..struct.compile import LaneCompiler
+    from ..struct.shapes import infer_shapes, typeok_hints
+
+    system = model.system
+    hints = typeok_hints(system.ev, model.invariants, system.variables)
+    var_shapes = infer_shapes(system.ev, system.variables,
+                              system.init_ast, system.next_ast,
+                              hints=hints)
+    return LaneCompiler(system.ev, system.variables, var_shapes,
+                        backend.cdc)
+
+
+def compile_predicates(compiler, candidates) -> list:
+    """Candidate ASTs -> batch lane functions ([B, F] -> [B] bool).
+    A candidate outside the compiler's subset is replaced by a
+    constant-True lane (it can never be killed on device, and the
+    driver reports it uncompiled instead of certified)."""
+    import jax.numpy as jnp
+
+    fns = []
+    uncompiled = []
+    for i, c in enumerate(candidates):
+        try:
+            fns.append(compiler.build_invariant(c.ast))
+        except Exception:
+            uncompiled.append(i)
+            fns.append(lambda fields: jnp.ones(fields.shape[0], bool))
+    return fns, uncompiled
+
+
+def make_filter_fn(inv_fns: list):
+    """The [P, S] kernel: one jitted dispatch vmapping the stacked
+    per-state candidate vector over the evidence block."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(vec):  # [F] -> [P]
+        return jnp.stack([fn(vec[None])[0] for fn in inv_fns])
+
+    def f(fields):  # [B, F] -> [P, B]
+        return jnp.transpose(jax.vmap(one)(fields))
+
+    return jax.jit(f)
+
+
+def filter_matrix(filter_fn, fields: np.ndarray,
+                  block: int = FILTER_BLOCK) -> np.ndarray:
+    """[P, S] candidate-holds matrix over `fields` ([S, F] int32),
+    dispatched in fixed-size blocks padded with replicas of the first
+    real row (a real state: padding can never fabricate a kill the
+    evidence does not contain)."""
+    n = fields.shape[0]
+    cols: List[np.ndarray] = []
+    for start in range(0, n, block):
+        b = fields[start:start + block]
+        real = b.shape[0]
+        if real < block:
+            b = np.concatenate(
+                [b, np.repeat(b[:1], block - real, axis=0)], axis=0
+            )
+        cols.append(np.asarray(filter_fn(b))[:, :real])
+    return np.concatenate(cols, axis=1) if cols else np.zeros(
+        (0, 0), bool)
+
+
+def host_filter(system, candidates, states: list) -> np.ndarray:
+    """The pure-host reference [P, S] matrix: `ev.eval` of every
+    candidate over every decoded state - the oracle the device kernel
+    is pinned bit-for-bit against.  An evaluation error counts as a
+    refutation (the device lane traps the same way TLC errors)."""
+    ev = system.ev
+    out = np.zeros((len(candidates), len(states)), bool)
+    for s_i, st in enumerate(states):
+        env = dict(ev.constants)
+        env.update(zip(system.variables, st))
+        for c_i, c in enumerate(candidates):
+            try:
+                out[c_i, s_i] = ev.eval(c.ast, env) is True
+            except Exception:
+                out[c_i, s_i] = False
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Evidence sources
+# ---------------------------------------------------------------------------
+
+
+def artifact_fields(model, backend,
+                    check_deadlock: bool = True
+                    ) -> Optional[np.ndarray]:
+    """Exact reachable evidence from the PR 13 artifact store, as
+    decoded field vectors [N, F] int32 - None on miss (no store, no
+    artifact, or a codec that does not match this backend's)."""
+    import jax.numpy as jnp
+
+    from ..struct import artifacts as arts
+
+    store = arts.get_store()
+    if store is None:
+        return None
+    hit = store.lookup_reach(arts.reach_key(model, check_deadlock))
+    if hit is None:
+        return None
+    states, meta = hit
+    codec = meta.get("codec_digest")
+    if codec != arts.codec_digest(backend.cdc):
+        return None  # narrowed-run artifact: packed under another codec
+    if states.shape[1] * 32 < backend.cdc.nbits:
+        return None
+    return np.asarray(backend.cdc.unpack(jnp.asarray(states)))
+
+
+def bfs_fields(model, backend, check_deadlock: bool = True,
+               max_states: int = DEFAULT_MAX_HOST_STATES
+               ) -> Optional[Tuple[np.ndarray, list]]:
+    """Exact reachable evidence from a host-oracle BFS: (fields [N, F],
+    decoded state tuples) - None when the space exceeds `max_states`
+    (the intractable case the sampled tier exists for)."""
+    from ..struct import oracle as so
+
+    try:
+        r = so.bfs(model.system, {}, check_deadlock=False,
+                   max_states=max_states, stop_on_violation=False,
+                   collect_states=True)
+    except RuntimeError:
+        return None
+    states = list(r.states)
+    fields = np.stack([backend.cdc.encode(st) for st in states])
+    return fields.astype(np.int32), states
+
+
+def sim_fields(model, walkers: int, depth: int, seed: int,
+               check_deadlock: bool = True,
+               rounds: int = 4) -> List[np.ndarray]:
+    """Sampled evidence streamed out of the sim tier: the PR 14 walk
+    advanced one step at a time through its (memoized, jitted) step
+    function, every round's lane states SNAPSHOTTED into the filter
+    stream instead of discarded.  Returns `rounds` deduplicated field
+    chunks [N_i, F] (the per-round kill accounting the journal
+    reports)."""
+    from ..sim.engine import get_sim_engine
+
+    _b, init_fn, _run_fn, step_fn = get_sim_engine(
+        model, walkers, depth, 0, check_deadlock=check_deadlock
+    )
+    carry = init_fn(seed)
+    chunks = [np.asarray(carry.states)]
+    for _ in range(depth):
+        carry = step_fn(carry)
+        chunks.append(np.asarray(carry.states))
+    per = max(1, math.ceil(len(chunks) / max(rounds, 1)))
+    out = []
+    for start in range(0, len(chunks), per):
+        seg = np.concatenate(chunks[start:start + per], axis=0)
+        out.append(np.unique(seg, axis=0).astype(np.int32))
+    return out

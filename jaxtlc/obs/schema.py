@@ -126,6 +126,14 @@ EVENTS = {
     # cumulative transitions taken across all lanes
     "sim": {"phase": _STR, "walkers": _NUM, "depth": _NUM,
             "steps": _NUM, "transitions": _NUM},
+    # one inference progress row: a filter round (phase "round", extra
+    # fields: round, evidence, n_states) or the run summary (phase
+    # "summary", extra fields: certified_names, evidence, n_states,
+    # dropped).  `candidates` is the conjectured pool size, `killed`
+    # the cumulative evidence refutations, `certified` the survivors
+    # with a machine-checked inductive basis
+    "infer": {"phase": _STR, "candidates": _NUM, "killed": _NUM,
+              "survivors": _NUM, "certified": _NUM},
     # -- derived artifacts -------------------------------------------------
     "trace_export": {"path": _STR, "events": _NUM},
     # one bench.py metric payload (the BENCH_*.json line contract)

@@ -138,6 +138,18 @@ def metrics_from_events(events) -> dict:
         out["sim_transitions"] = sim["transitions"]
         if "distinct_est" in sim:
             out["sim_distinct_estimate"] = sim["distinct_est"]
+    inf = next((e for e in reversed(events) if e["event"] == "infer"),
+               None)
+    if inf is not None:
+        # inference tier (ISSUE 16): the candidate-pool funnel as
+        # Prometheus gauges (jaxtlc_infer_*) - conjectured, killed by
+        # evidence, surviving, certified inductive
+        out["infer_candidates"] = inf["candidates"]
+        out["infer_killed"] = inf["killed"]
+        out["infer_survivors"] = inf["survivors"]
+        out["infer_certified"] = inf["certified"]
+        if "n_states" in inf:
+            out["infer_evidence_states"] = inf["n_states"]
     sp = next((e for e in reversed(events) if e["event"] == "spill"),
               None)
     if sp is not None:
@@ -291,6 +303,19 @@ def render_tlc_event(log, ev: dict, resume_cmd: str = "") -> None:
             )
         # flushes are journal-only (one per highwater crossing - a
         # banner each would flood the transcript; tlcstat shows them)
+    elif kind == "infer" and ev.get("phase") == "round":
+        # inference filter rounds (ISSUE 16): one banner per evidence
+        # round - the candidate-funnel's live surface (the summary row
+        # stays journal-only; the API path renders its own verdict
+        # lines with the certified invariant texts)
+        log.msg(
+            1000,
+            f"Inference round {ev.get('round', '?')}: "
+            f"{ev['killed']} of {ev['candidates']} candidates killed "
+            f"against {ev.get('n_states', 0):,} "
+            f"{ev.get('evidence', '')} evidence states "
+            f"({ev['survivors']} survive).",
+        )
     elif kind == "exhausted":
         log.msg(
             1000,
@@ -323,6 +348,10 @@ _BENCH_BASE = {
     # claimants (True - bench.py --expand-ab); modes that run both
     # put their setting in explicitly, like "pipeline"/"sort_free"
     "deferred": False,
+    # which job class produced the number (ISSUE 16): checking (False)
+    # or the invariant-inference predicates x states filter (True -
+    # predicate-evals/s payloads, bench.py --infer)
+    "infer": False,
 }
 
 

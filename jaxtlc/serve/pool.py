@@ -317,6 +317,40 @@ class EnginePool:
                  depth=int(depth), fp_capacity=int(fp_capacity)),
         )
 
+    def get_infer(
+        self,
+        model,
+        budget: int = 64,
+        walkers: int = 64,
+        depth: int = 64,
+        check_deadlock: bool = True,
+        max_host_states: int = None,
+    ) -> PoolEntry:
+        """Warm inference engine for the infer job class (jaxtlc.infer,
+        ISSUE 16).  Like sim, the SEED is run data - candidate pool,
+        filter/certify kernels (AOT against their fixed block shapes)
+        and exact evidence all build once per (model, budget, walk
+        geometry) class, so a warm resubmit is pure dispatch."""
+        from ..infer.driver import InferEngine
+        from ..infer.filter import DEFAULT_MAX_HOST_STATES
+        from ..struct.cache import model_key
+
+        if max_host_states is None:
+            max_host_states = DEFAULT_MAX_HOST_STATES
+        key = ("infer", model_key(model), int(budget), int(walkers),
+               int(depth), bool(check_deadlock), int(max_host_states))
+        return self._get_or_build(
+            key,
+            lambda: InferEngine(
+                model, budget=budget, walkers=walkers, depth=depth,
+                check_deadlock=check_deadlock,
+                max_host_states=max_host_states,
+            ),
+            "infer",
+            dict(workload=model.root_name, budget=int(budget),
+                 walkers=int(walkers), depth=int(depth)),
+        )
+
     # -- prewarm (ISSUE 13 satellite) --------------------------------------
 
     def prewarm(self, specs, chunk: int = None, queue_capacity: int = None,

@@ -70,6 +70,7 @@ _REQUEST_OPTIONS = (
     "fairness", "nodeadlock", "faults", "retry", "maxregrow", "spill",
     "obs", "obsslots", "coverage", "recheck", "noartifactcache",
     "simulate", "depth", "walkers", "simseed",
+    "infer", "inferbudget",
 )
 _HEAVY_OPTIONS = ("checkpoint", "recover", "sharded", "liveness",
                   "faults", "coverage")
@@ -126,17 +127,25 @@ class Job:
         through the warm sim engine - the cheap per-commit check."""
         return bool(self.options.get("simulate"))
 
+    def is_infer(self) -> bool:
+        """The inference job class (options.infer): conjecture ->
+        filter -> certify through the warm infer engine (ISSUE 16)."""
+        return bool(self.options.get("infer"))
+
     def batch_signature(self) -> str:
         """Jobs with equal signatures fold into one vmapped dispatch:
         identical spec/cfg/options/sweep, constants equal OUTSIDE the
         swept names (inside them is the batch axis).  Smoke jobs
         additionally drop `simseed` from the compared options - the
         seed is a batch lane, so one warm sim engine serves seeds x
-        configs in one dispatch (ISSUE 14)."""
+        configs in one dispatch (ISSUE 14).  Infer jobs drop it too:
+        the seed is run data against one warm infer engine (ISSUE
+        16)."""
         fixed = {k: v for k, v in sorted(self.constants.items())
                  if k not in self.sweep_params()}
         opts = {k: v for k, v in self.options.items()
-                if not (self.is_smoke() and k == "simseed")}
+                if not ((self.is_smoke() or self.is_infer())
+                        and k == "simseed")}
         blob = json.dumps(
             [self.spec, self.cfg, sorted(opts.items()),
              sorted((self.sweep or {}).items()), fixed],
@@ -277,7 +286,8 @@ class Scheduler:
                     return
                 head = self.jobs[self._queue.popleft()]
                 batch = [head]
-                if (head.sweep or head.is_smoke()) \
+                if (head.sweep or head.is_smoke()
+                        or head.is_infer()) \
                         and not head.is_large(self.large_fpcap):
                     # look ahead: fold queued jobs of the same class
                     # into this dispatch (FIFO among the folded; the
@@ -324,6 +334,9 @@ class Scheduler:
 
     def _run_batch(self, batch: List[Job]) -> None:
         head = batch[0]
+        if head.is_infer() and not head.is_large(self.large_fpcap):
+            self._run_infer(batch)
+            return
         if head.is_smoke() and not head.is_large(self.large_fpcap):
             self._run_smoke(batch)
             return
@@ -511,6 +524,122 @@ class Scheduler:
                 violation_step=r.violation_step,
             )
             self._finish_ok(j, res)
+
+    def _run_infer(self, batch: List[Job]) -> None:
+        """The inference job class (jaxtlc.infer, ISSUE 16): every job
+        in the folded batch runs through ONE warm infer engine - the
+        candidate pool, the AOT [P, S] filter kernel and the exact
+        evidence all belong to the engine, so the per-job work is pure
+        dispatch (the seed only matters under sampled evidence).  Like
+        sim, the artifact-cache verdict tier is BYPASSED (journaled
+        per job): an inference verdict is about CANDIDATES, not the
+        spec's stated invariants."""
+        import jax
+
+        from ..struct import artifacts as arts
+        from ..struct.loader import StructLoadError, load
+        from ..struct.parser import StructParseError
+
+        head = batch[0]
+        cfg_path = self._jobdir(head)
+        fixed = _loader_constants(head.constants)
+        try:
+            model = load(cfg_path, const_overrides=fixed or None)
+        except (StructLoadError, StructParseError):
+            # inference conjectures over the struct IR: route through
+            # api.run_check with the frontend forced struct (it runs
+            # any spec) so the job still gets a real answer or a real
+            # error
+            for j in batch:
+                self._run_supervised(j, frontend="struct")
+            return
+        o = head.options
+        budget = int(o.get("inferbudget", 64))
+        walkers = int(o.get("walkers", DEFAULT_SIM_WALKERS))
+        depth = int(o.get("depth", DEFAULT_SIM_DEPTH))
+        check_deadlock = not o.get("nodeadlock", False)
+        pre = self.pool.hits
+        entry = self.pool.get_infer(
+            model, budget=budget, walkers=walkers, depth=depth,
+            check_deadlock=check_deadlock,
+        )
+        hit = self.pool.hits > pre
+        bypass = (arts.get_store() is not None
+                  and not o.get("noartifactcache"))
+        device = str(jax.devices()[0])
+        for j in batch:
+            if j is not head:
+                self._jobdir(j)
+            jr = self._journal(j)
+            jr.event("run_start", version=_version(), workload=j.name,
+                     engine="infer", device=device,
+                     params=dict(budget=budget, walkers=walkers,
+                                 depth=depth,
+                                 sim_seed=int(j.options.get(
+                                     "simseed", 0)),
+                                 constants=j.constants,
+                                 batch=len(batch), pool_hit=hit))
+            if bypass:
+                jr.event("cache", tier="verdict", outcome="bypass",
+                         key="", reason="inference verdicts are about "
+                                        "candidate invariants and "
+                                        "never publish")
+            try:
+                rep = entry.runner.run(
+                    seed=int(j.options.get("simseed", 0)))
+            except BaseException:
+                self._abort_journals([jr])
+                raise
+            jr.event("infer", phase="summary",
+                     candidates=rep.candidates, killed=rep.killed,
+                     survivors=len(rep.survivors),
+                     certified=len(rep.certified),
+                     certified_names=[c.name for c in rep.certified],
+                     evidence=rep.evidence, n_states=rep.n_states,
+                     dropped=rep.dropped)
+            violated = bool(rep.cfg_killed)
+            if violated:
+                jr.event("violation", code=100,
+                         name=f"Invariant {rep.cfg_killed[0]} is "
+                              f"violated.")
+            jr.event("final",
+                     verdict="violation" if violated else "ok",
+                     generated=rep.n_states, distinct=rep.n_states,
+                     depth=0, queue=0,
+                     wall_s=round(rep.wall_s, 6), interrupted=False)
+            jr.close()
+            res = dict(
+                verdict="violation" if violated else "ok",
+                violation=(100 if violated else 0),
+                violation_name=(f"Invariant {rep.cfg_killed[0]} is "
+                                f"violated." if violated else None),
+                generated=rep.n_states, distinct=rep.n_states,
+                depth=0, queue_left=0,
+                wall_s=round(rep.wall_s, 6),
+                engine="infer", pool_hit=hit,
+                infer=dict(
+                    candidates=rep.candidates, dropped=rep.dropped,
+                    killed=rep.killed, survivors=len(rep.survivors),
+                    certified=[
+                        dict(name=c.name, text=c.text, basis=b,
+                             implies=list(c.implies))
+                        for c, b in zip(rep.certified, rep.cert_basis)
+                    ],
+                    uncertified=[
+                        dict(name=c.name, text=c.text)
+                        for c in rep.survivors
+                        if c not in rep.certified
+                    ],
+                    uncompiled=list(rep.uncompiled),
+                    cfg_killed=list(rep.cfg_killed),
+                    evidence=rep.evidence, exact=rep.exact,
+                    n_states=rep.n_states, seed=rep.seed,
+                ),
+            )
+            self._finish_ok(j, res)
+        with self._cond:
+            self.batches_run += 1
+            self.batched_jobs += len(batch)
 
     def _run_pooled(self, job: Job) -> None:
         """Warm plain engine via the pool; falls back to the supervised

@@ -1093,15 +1093,21 @@ class LaneCompiler:
         )
 
     def _member_funcset(self, la, ra, env, ctx) -> LV:
+        f = self.comp(la, env, ctx)
+        return self._member_funcset_lv(f, ra, env, ctx)
+
+    def _member_funcset_lv(self, f, ra, env, ctx) -> LV:
         """f \\in [S -> T] without enumerating the function space: the
         domain is exactly S and every value lands in T (TypeOK's usual
-        function-typing conjunct)."""
+        function-typing conjunct).  A funcset codomain recurses per key
+        instead of compiling [S2 -> T2] as a value (two-level functions
+        like `view \\in [Sidecars -> [Endpoints -> {"ok","down"}]]`)."""
         _, s_ast, t_ast = ra
         s = self.comp(s_ast, env, ctx)
-        t = self.comp(t_ast, env, ctx)
         if not isinstance(s, LC) or not isinstance(s.value, frozenset):
             raise CompileError("[S -> T] with dynamic domain")
-        f = self.comp(la, env, ctx)
+        nested = isinstance(t_ast, tuple) and t_ast and t_ast[0] == "funcset"
+        t = None if nested else self.comp(t_ast, env, ctx)
         if isinstance(f, LE):
             f = self.explode(f)
         if not isinstance(f, LRec):
@@ -1118,7 +1124,13 @@ class LaneCompiler:
             if v is None:
                 return LC(False)
             out = self._land(out, p)
-            out = self._land(out, self._member_lv(v, t))
+            if nested:
+                if isinstance(v, LE):
+                    v = self.explode(v)
+                out = self._land(out,
+                                 self._member_funcset_lv(v, t_ast, env, ctx))
+            else:
+                out = self._land(out, self._member_lv(v, t))
         return out
 
     def _eq_lv(self, a, b) -> LV:

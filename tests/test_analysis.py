@@ -541,9 +541,9 @@ def test_selfcheck_registry_pinned():
     from jaxtlc.analysis.selfcheck import FACTORIES
 
     assert sorted(FACTORIES) == [
-        "covered", "deferred", "enumerator", "fused", "narrowed",
-        "phased", "pipelined", "sharded", "sim", "sortfree", "spill",
-        "struct", "sweep",
+        "covered", "deferred", "enumerator", "fused", "infer",
+        "narrowed", "phased", "pipelined", "sharded", "sim",
+        "sortfree", "spill", "struct", "sweep",
     ]
 
 

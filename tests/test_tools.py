@@ -180,6 +180,23 @@ def test_loadgen_sim_tiny_smoke(capsys):
     assert report["transitions"] > 0
 
 
+def test_loadgen_infer_tiny_smoke(capsys):
+    """tools/loadgen.py --infer --tiny: the inference job class under
+    load - 1 cold + 3 warm infer submits (different evidence seeds,
+    ONE warm engine, zero fresh XLA compiles asserted), with the
+    candidate funnel reported (ISSUE 16 CI wiring)."""
+    mod = _load_tool("loadgen")
+    assert mod.main(["--infer", "--tiny"]) == 0
+    out = capsys.readouterr().out
+    assert "loadgen OK" in out, out
+    report = json.loads(out[: out.index("loadgen OK")])
+    assert report["infer_fresh_xla_compiles"] == 0
+    assert report["pool"]["hits"] >= report["jobs"] - 1
+    assert report["infer_p50_s"] <= report["infer_p95_s"]
+    assert report["candidates"] > 0
+    assert report["certified"] > 0
+
+
 def test_cachectl_tiny_smoke(capsys):
     """tools/cachectl.py --tiny: synthetic artifact store -> ls ->
     verify (clean + after a deliberate corruption) -> gc to a byte

@@ -267,6 +267,66 @@ def run_sim_load(url: str, jobs: int, in_process: bool,
     return report
 
 
+def run_infer_load(url: str, jobs: int, in_process: bool,
+                   out=sys.stdout) -> dict:
+    """The --infer mode (ISSUE 16): the inference job class under
+    load.  Submit 1 cold + N-1 warm infer jobs (same spec, DIFFERENT
+    seeds - the seed only drives sampled evidence, not key material,
+    so every resubmit after the first must be a pool HIT with ZERO
+    fresh XLA compiles)."""
+    from jaxtlc.serve import client
+    from jaxtlc.serve.pool import xla_compiles
+
+    opts = dict(infer=True, inferbudget=16, walkers=16, depth=32,
+                nodeadlock=True)
+    t0 = time.time()
+    cold = client.check(url, _SPEC, _CFG, name="infer-cold",
+                        options=dict(opts, simseed=0))
+    cold_s = time.time() - t0
+    assert cold["state"] == "done", cold
+    assert cold["result"]["engine"] == "infer", cold
+    assert cold["result"]["verdict"] == "ok", cold
+    funnel = cold["result"]["infer"]
+    assert funnel["candidates"] > 0, funnel
+
+    warm_lat = []
+    pre = xla_compiles() if in_process else None
+    for i in range(max(0, jobs - 1)):
+        t0 = time.time()
+        st = client.check(url, _SPEC, _CFG, name=f"infer-warm-{i}",
+                          options=dict(opts, simseed=i + 1))
+        warm_lat.append(time.time() - t0)
+        assert st["state"] == "done", st
+        assert st["result"]["engine"] == "infer", st
+        assert st["result"]["pool_hit"] is True, st
+    fresh = (xla_compiles() - pre) if in_process else 0
+    assert fresh == 0, (
+        f"warm infer path paid {fresh} fresh XLA compiles"
+    )
+
+    stats = client.pool_stats(url)
+    report = dict(
+        jobs=jobs,
+        cold_s=round(cold_s, 4),
+        infer_p50_s=round(_pct(warm_lat, 0.50), 4),
+        infer_p95_s=round(_pct(warm_lat, 0.95), 4),
+        infer_fresh_xla_compiles=fresh,
+        candidates=funnel["candidates"],
+        survivors=funnel["survivors"],
+        certified=len(funnel["certified"]),
+        evidence=funnel["evidence"],
+        pool=dict(hits=stats["pool"]["hits"],
+                  misses=stats["pool"]["misses"],
+                  size=stats["pool"]["size"]),
+        scheduler=dict(
+            batches_run=stats["scheduler"]["batches_run"],
+            batched_jobs=stats["scheduler"]["batched_jobs"],
+        ),
+    )
+    out.write(json.dumps(report, indent=2, sort_keys=True) + "\n")
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="loadgen")
     p.add_argument("--url", default="",
@@ -282,6 +342,12 @@ def main(argv=None) -> int:
                         "warm engine - zero fresh XLA compiles "
                         "asserted) plus a folded seed-batch burst; "
                         "reports warm sim p50/p95")
+    p.add_argument("--infer", action="store_true",
+                   help="inference job class mode (ISSUE 16): 1 cold "
+                        "+ N-1 warm infer submits (different evidence "
+                        "seeds, same warm engine - zero fresh XLA "
+                        "compiles asserted); reports warm infer "
+                        "p50/p95 and the candidate funnel")
     p.add_argument("--cache", action="store_true",
                    help="incremental re-checking mode (ISSUE 13): N "
                         "identical submits; 1 cold population run, "
@@ -332,6 +398,23 @@ def main(argv=None) -> int:
                   f"{report['scheduler']['batched_jobs']} jobs "
                   f"through {report['scheduler']['batches_run']} "
                   "dispatches")
+            return 0 if ok else 1
+        if args.infer:
+            report = run_infer_load(url, args.jobs,
+                                    in_process=srv is not None)
+            ok = (report["infer_fresh_xla_compiles"] == 0
+                  and report["pool"]["hits"] >= args.jobs - 1)
+            print(f"loadgen {'OK' if ok else 'FAILED'}: "
+                  f"{args.jobs} infer submits (1 cold + "
+                  f"{args.jobs - 1} warm), "
+                  f"{report['candidates']} candidates -> "
+                  f"{report['survivors']} survive -> "
+                  f"{report['certified']} certified "
+                  f"[{report['evidence']} evidence], "
+                  f"warm infer p50 "
+                  f"{report['infer_p50_s'] * 1000:.1f} ms "
+                  f"/ p95 {report['infer_p95_s'] * 1000:.1f} ms, "
+                  f"0 fresh compiles on the warm path")
             return 0 if ok else 1
         if args.cache:
             report = run_cache(url, args.jobs, in_process=srv is not None)

@@ -147,6 +147,19 @@ def render(events) -> str:
             f"{sim['transitions']:,} transitions  "
             f"~{est:,} distinct sampled{sat}"
         )
+    # inference tier (jaxtlc.infer): the candidate funnel of the most
+    # recent infer event - conjectured -> killed -> surviving ->
+    # certified (an inference run's whole progress story)
+    inf = next((e for e in reversed(events) if e["event"] == "infer"),
+               None)
+    if inf is not None:
+        lines.append(
+            f"infer: {inf['candidates']} candidates  "
+            f"{inf['killed']} killed  {inf['survivors']} survive  "
+            f"{inf['certified']} certified  "
+            f"[{inf.get('evidence', '?')} x "
+            f"{inf.get('n_states', 0):,} states]"
+        )
     # incremental re-checking (struct.artifacts): this run's artifact
     # cache decisions - a hit means the verdict was replayed (or BFS
     # skipped) instead of re-explored
