@@ -121,6 +121,15 @@ class CheckRequest:
     # baked values (the serve path: a job's constants must shape the
     # checked configuration on EVERY route, supervised included)
     constants: dict = dataclasses.field(default_factory=dict)
+    # programmatic drain request (ISSUE 17): a threading.Event the
+    # caller sets to preempt THIS run at the next segment boundary -
+    # the in-process twin of SIGTERM, riding the same checkpoint +
+    # exit-75 machinery (resil.supervisor / sim.driver honor it).  The
+    # serve scheduler's deadline/priority/cancel preemptions all route
+    # through here, so preempting one job never signals the server
+    drain: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
     # transcript / error sinks; None = the process stdout / stderr (the
     # CLI path - pinned transcripts depend on it)
     out: Optional[TextIO] = dataclasses.field(
@@ -611,6 +620,7 @@ def _sup_opts(args, log, capture_fps: bool = False):
         faults=FaultPlan.parse(args.faults) if args.faults else None,
         capture_fps=capture_fps,
         on_event=on_event,
+        drain=getattr(args, "drain", None),
     )
 
 
@@ -1223,6 +1233,7 @@ def _run_sim_struct(args, spec) -> int:
             faults=(FaultPlan.parse(args.faults) if args.faults
                     else None),
             on_event=on_event,
+            drain=getattr(args, "drain", None),
         )
     except (FileNotFoundError, ValueError) as e:
         print(f"Error: {e}", file=_err(args))

@@ -134,6 +134,13 @@ EVENTS = {
     # with a machine-checked inductive basis
     "infer": {"phase": _STR, "candidates": _NUM, "killed": _NUM,
               "survivors": _NUM, "certified": _NUM},
+    # -- serve-plane scheduling (serve.scheduler, ISSUE 17) ----------------
+    # one per scheduler decision, written to the scheduler's own
+    # journal (root/sched.journal.jsonl): action in ("admit", "reject",
+    # "expire", "preempt", "requeue", "retry", "quarantine", "cancel",
+    # "dispatch").  Extra fields carry the decision's facts (tenant,
+    # priority, reason, retry_after_s, queued = queue depth after)
+    "sched": {"action": _STR, "job": _STR},
     # -- derived artifacts -------------------------------------------------
     "trace_export": {"path": _STR, "events": _NUM},
     # one bench.py metric payload (the BENCH_*.json line contract)
@@ -141,9 +148,13 @@ EVENTS = {
                      "vs_baseline": _NUM},
 }
 
-# the verdict vocabulary of the "final" event
+# the verdict vocabulary of the "final" event.  The last three are
+# scheduler-terminal verdicts (ISSUE 17): a job that never got (or
+# never finished) an engine run still ends its journal with exactly one
+# final event - deadline-expired, client-canceled, or breaker-
+# quarantined - so SSE followers terminate on every outcome
 VERDICTS = ("ok", "violation", "liveness_violation", "interrupted",
-            "exhausted", "error")
+            "exhausted", "error", "expired", "canceled", "quarantined")
 
 
 class JournalSchemaError(ValueError):

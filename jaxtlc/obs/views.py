@@ -150,6 +150,23 @@ def metrics_from_events(events) -> dict:
         out["infer_certified"] = inf["certified"]
         if "n_states" in inf:
             out["infer_evidence_states"] = inf["n_states"]
+    sched_evs = [e for e in events if e["event"] == "sched"]
+    if sched_evs:
+        # serve-plane control decisions (ISSUE 17): the scheduler's
+        # own journal as Prometheus counters (jaxtlc_sched_*) - one
+        # per admit/reject/expire/preempt/requeue/retry/quarantine/
+        # cancel decision, plus the queue depth the latest decision
+        # observed
+        for action in ("admit", "reject", "expire", "preempt",
+                       "requeue", "retry", "quarantine", "cancel",
+                       "dispatch"):
+            n = sum(1 for e in sched_evs if e.get("action") == action)
+            if n:
+                out[f"sched_{action}_total"] = n
+        depth = next((e["queued"] for e in reversed(sched_evs)
+                      if "queued" in e), None)
+        if depth is not None:
+            out["sched_queue_depth"] = depth
     sp = next((e for e in reversed(events) if e["event"] == "spill"),
               None)
     if sp is not None:
@@ -316,6 +333,23 @@ def render_tlc_event(log, ev: dict, resume_cmd: str = "") -> None:
             f"{ev.get('evidence', '')} evidence states "
             f"({ev['survivors']} survive).",
         )
+    elif kind == "sched" and ev.get("action") in (
+            "reject", "expire", "preempt", "quarantine"):
+        # serve-plane control decisions (ISSUE 17): the LOAD-SHEDDING
+        # ones get banners (admit/dispatch/retry/requeue/cancel are
+        # high-rate bookkeeping - journal + /metrics only)
+        what = {
+            "reject": f"admission rejected job {ev['job']} "
+                      f"({ev.get('reason', 'queue_bound')}; "
+                      f"retry after {ev.get('retry_after_s', '?')}s)",
+            "expire": f"job {ev['job']} expired "
+                      f"({ev.get('reason', 'deadline')})",
+            "preempt": f"job {ev['job']} preempted "
+                       f"({ev.get('reason', 'priority')})",
+            "quarantine": f"job {ev['job']} quarantined "
+                          f"({ev.get('reason', 'circuit open')})",
+        }[ev["action"]]
+        log.msg(1000, f"Scheduler: {what}.", severity=1)
     elif kind == "exhausted":
         log.msg(
             1000,

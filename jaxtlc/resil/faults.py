@@ -26,6 +26,12 @@ The plan DSL (tools/chaos.py `--plan`):
     spill_fail@N    raise OSError on the Nth host spill write (the
                     device-table flush into the SpillStore, 1-based);
                     the ladder must degrade to checkpoint + exit 75
+    runner_die@N    raise TransientFault when the serve scheduler's Nth
+                    dispatch starts (1-based) - the scheduler's retry
+                    classification must absorb it (ISSUE 17)
+    slow_dispatch@N sleep before the serve scheduler's Nth dispatch
+                    (1-based) - the deterministic window the deadline
+                    reaper / admission tests need (ISSUE 17)
 
 Entries are comma-separated: "transient@1,sigterm@3".  Each entry fires
 at most once.
@@ -36,6 +42,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import signal
+import time
 from typing import Callable, FrozenSet, Optional
 
 
@@ -66,13 +73,16 @@ class FaultPlan:
     sigterm: FrozenSet[int] = frozenset()
     alloc_fail: FrozenSet[int] = frozenset()
     spill_fail: FrozenSet[int] = frozenset()
+    runner_die: FrozenSet[int] = frozenset()
+    slow_dispatch: FrozenSet[int] = frozenset()
 
     @staticmethod
     def parse(spec: str) -> "FaultPlan":
         """Parse the chaos DSL ("write_fail@2,transient@1,sigterm@3")."""
         kinds = {"write_fail": set(), "truncate": set(),
                  "transient": set(), "sigterm": set(),
-                 "alloc_fail": set(), "spill_fail": set()}
+                 "alloc_fail": set(), "spill_fail": set(),
+                 "runner_die": set(), "slow_dispatch": set()}
         for entry in filter(None, (e.strip() for e in spec.split(","))):
             try:
                 kind, at = entry.split("@")
@@ -89,6 +99,10 @@ class FaultInjector:
     """Runtime state of one plan: counts writes/segments, fires each
     scheduled fault exactly once.  A None plan injects nothing (the
     production configuration - the hooks cost a comparison each)."""
+
+    # how long a slow_dispatch@N fault stalls the scheduler (seconds);
+    # an attribute so chaos harnesses can tighten/loosen the window
+    slow_dispatch_s = 0.25
 
     def __init__(self, plan: Optional[FaultPlan] = None,
                  kill: Callable[[], None] = None):
@@ -114,6 +128,21 @@ class FaultInjector:
             self._kill()
         if k in self.plan.transient and self._once(("transient", k)):
             raise TransientFault(f"injected transient fault at segment {k}")
+
+    def dispatch(self, n: int) -> None:
+        """Hook: the serve scheduler is about to run its nth dispatch
+        (1-based).  `slow_dispatch` stalls the worker (opening the
+        deterministic window the deadline/admission chaos scenarios
+        need); `runner_die` kills the dispatch with a TransientFault
+        the scheduler's retry classification must absorb."""
+        if n in self.plan.slow_dispatch and self._once(
+            ("slow_dispatch", n)
+        ):
+            time.sleep(self.slow_dispatch_s)
+        if n in self.plan.runner_die and self._once(("runner_die", n)):
+            raise TransientFault(
+                f"injected runner death at dispatch {n}"
+            )
 
     def before_write(self) -> None:
         """Hook: a checkpoint write is about to happen (counts 1-based)."""

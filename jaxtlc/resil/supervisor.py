@@ -206,6 +206,14 @@ class SupervisorOptions:
     # the spill tier's table is partial and the sharded carry is
     # per-device (CAPTURES_FPS on the adapter gates it)
     capture_fps: bool = False
+    # programmatic drain request (ISSUE 17): a threading.Event twin of
+    # _SignalCatcher for in-process preemption - the serve scheduler
+    # sets it to preempt ONE supervised job (deadline / priority /
+    # cancel) without signaling the whole server.  Checked at the same
+    # segment boundaries as sig.hit, so a drained run rides the
+    # identical checkpoint + exit-75 machinery and its -recover resume
+    # is bit-for-bit the uninterrupted run
+    drain: Optional[object] = None
     # on_event(kind, info_dict): checkpoint / ckpt_write_failed / recovery
     # / regrow / retry / interrupted / progress / spill / degrade /
     # exhausted - the tlc_log banner seam
@@ -925,9 +933,10 @@ def supervise(adapter, params: dict,
         if spill_rt is not None and good_store is not None:
             spill_rt.store.restore(good_store)
 
+    drained = (lambda: opts.drain is not None and opts.drain.is_set())
     with _SignalCatcher() as sig:
         while not adapter.done(carry):
-            if sig.hit is not None:
+            if sig.hit is not None or drained():
                 interrupted = True
                 break
 
@@ -1217,7 +1226,8 @@ def supervise(adapter, params: dict,
                 _emit(opts, "interrupted",
                       signum=int(sig.hit) if sig.hit else None,
                       path=path, generated=g, distinct=di, queue=q,
-                      wall_s=round(time.time() - t0, 6))
+                      wall_s=round(time.time() - t0, 6),
+                      drained=drained())
         else:
             flush_save()
 

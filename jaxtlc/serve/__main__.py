@@ -35,6 +35,13 @@ def main(argv=None) -> int:
                         "FIRST submit of each rides the warm path "
                         "(compiled at the pooled-path default geometry "
                         "in a background thread; progress on /pool)")
+    p.add_argument("--queue-bound", type=int, default=None,
+                   help="admission bound on queued jobs (submits "
+                        "beyond it get 429 + Retry-After)")
+    p.add_argument("--tenant-quota", type=int, default=None,
+                   help="per-tenant bound on queued jobs (fair-share "
+                        "admission; dequeue is weighted round-robin "
+                        "between tenants regardless)")
     p.add_argument("--tiny", action="store_true",
                    help="smoke: serve + submit + assert warm reuse, "
                         "then exit")
@@ -48,10 +55,11 @@ def main(argv=None) -> int:
         pool_capacity=args.pool_cap, sweep_width=args.sweep_width,
         large_fpcap=args.large_fpcap,
         prewarm=[s for s in args.prewarm.split(",") if s],
+        queue_bound=args.queue_bound, tenant_quota=args.tenant_quota,
     )
     print(f"jaxtlc checking service at {srv.url} "
-          f"(POST /jobs; GET /jobs /pool /runs /metrics /events; "
-          f"runs dir {srv.root}; ctrl-c exits)")
+          f"(POST /jobs, DELETE /jobs/<id>; GET /jobs /pool /health "
+          f"/runs /metrics /events; runs dir {srv.root}; ctrl-c exits)")
     try:
         while True:
             time.sleep(3600)
@@ -113,12 +121,18 @@ def _tiny() -> int:
         assert warm["result"]["generated"] == cold["result"]["generated"]
         stats = client.pool_stats(srv.url)
         assert stats["pool"]["hits"] >= 1, stats
+        # two job journals + the scheduler's own control-plane journal
         runs = client._get(srv.url + "/runs")["runs"]
-        assert len(runs) == 2, runs
+        assert len(runs) == 3, runs
+        assert any(r["run"] == "sched" for r in runs), runs
+        h = client.health(srv.url)
+        assert h["status"] == "ok" and h["queued"] == 0, h
+        assert h["counters"]["admitted"] >= 2, h
     finally:
         srv.shutdown()
     print("serve tiny OK: cold compile -> warm resubmit with 0 fresh "
-          "XLA compiles, verdicts ok, 2 runs registered")
+          "XLA compiles, verdicts ok, 2 job runs + sched journal "
+          "registered, /health ok")
     return 0
 
 

@@ -1,7 +1,10 @@
 """Thin client for the checking service (stdlib urllib only).
 
 `submit` posts a job, `wait` polls it to completion, `stream` follows
-the job-scoped SSE event feed, `check` is submit+wait in one call.
+the job-scoped SSE event feed, `check` is submit+wait in one call,
+`cancel` is DELETE /jobs/<id>.  A 429 from admission control (ISSUE
+17) is retried automatically, honoring the server's drain-rate
+``Retry-After`` with capped deterministic-jitter backoff.
 The CLI form drives a live server from a model directory::
 
     python -m jaxtlc.serve.client http://HOST:PORT path/to/MC.cfg \
@@ -15,14 +18,27 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import time
 import urllib.error
 import urllib.request
 from typing import Dict, Iterator, Optional
 
+# deterministic jitter for the 429 backoff: two identical overload
+# replays back off on the same clock
+_RNG = random.Random(0x5EED429)
+
 
 class ClientError(RuntimeError):
-    pass
+    """An HTTP-level failure.  `code` is the status (0 when the error
+    was not an HTTP response); `retry_after` carries a 429's
+    Retry-After hint in seconds (None otherwise)."""
+
+    def __init__(self, msg: str, code: int = 0,
+                 retry_after: Optional[int] = None):
+        super().__init__(msg)
+        self.code = int(code)
+        self.retry_after = retry_after
 
 
 def _post(url: str, payload: dict, timeout: float = 30.0) -> dict:
@@ -34,7 +50,10 @@ def _post(url: str, payload: dict, timeout: float = 30.0) -> dict:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return json.loads(r.read().decode())
     except urllib.error.HTTPError as e:
-        raise ClientError(f"{url}: {e.code} {e.read().decode()}")
+        ra = e.headers.get("Retry-After")
+        raise ClientError(f"{url}: {e.code} {e.read().decode()}",
+                          code=e.code,
+                          retry_after=(int(ra) if ra else None))
 
 
 def _get(url: str, timeout: float = 30.0) -> dict:
@@ -44,14 +63,31 @@ def _get(url: str, timeout: float = 30.0) -> dict:
 
 def submit(url: str, spec: str, cfg: str, name: str = "",
            constants: Optional[Dict] = None, sweep: Optional[Dict] = None,
-           options: Optional[Dict] = None) -> str:
-    """POST /jobs; returns the job id."""
-    out = _post(url.rstrip("/") + "/jobs", {
-        "spec": spec, "cfg": cfg, "name": name,
-        "constants": constants or {}, "sweep": sweep,
-        "options": options or {},
-    })
-    return out["id"]
+           options: Optional[Dict] = None, tenant: str = None,
+           retries: int = 4, backoff_cap_s: float = 30.0) -> str:
+    """POST /jobs; returns the job id.
+
+    A 429 (admission control) is retried up to `retries` times: each
+    attempt sleeps the server's Retry-After hint scaled by a
+    deterministic jitter in [0.5, 1.0), doubled per attempt and capped
+    at `backoff_cap_s` - honoring the server's estimate without
+    thundering back in lockstep.  `retries=0` surfaces the 429 raw."""
+    attempt = 0
+    while True:
+        try:
+            out = _post(url.rstrip("/") + "/jobs", {
+                "spec": spec, "cfg": cfg, "name": name,
+                "constants": constants or {}, "sweep": sweep,
+                "options": options or {}, "tenant": tenant,
+            })
+            return out["id"]
+        except ClientError as e:
+            if e.code != 429 or attempt >= retries:
+                raise
+            attempt += 1
+            hint = max(1, e.retry_after or 1)
+            delay = min(backoff_cap_s, hint * (2 ** (attempt - 1)))
+            time.sleep(delay * (0.5 + 0.5 * _RNG.random()))
 
 
 def status(url: str, job_id: str) -> dict:
@@ -60,7 +96,9 @@ def status(url: str, job_id: str) -> dict:
 
 def wait(url: str, job_id: str, timeout: float = 300.0,
          poll_s: float = 0.05) -> dict:
-    """Poll until the job leaves queued/running; returns its record."""
+    """Poll until the job leaves queued/running; returns its record.
+    Returns immediately on EVERY terminal state - done, error, and the
+    scheduler-terminal expired / canceled / quarantined (ISSUE 17)."""
     deadline = time.time() + timeout
     while True:
         st = status(url, job_id)
@@ -70,6 +108,24 @@ def wait(url: str, job_id: str, timeout: float = 300.0,
             raise ClientError(f"job {job_id} still {st['state']} "
                               f"after {timeout}s")
         time.sleep(poll_s)
+
+
+def cancel(url: str, job_id: str, timeout: float = 30.0) -> dict:
+    """DELETE /jobs/<id>; returns the job record (state `canceled`
+    for a queued job; a running checkpointed heavy job drains through
+    the preempt path and reaches `canceled` shortly after)."""
+    req = urllib.request.Request(f"{url.rstrip('/')}/jobs/{job_id}",
+                                 method="DELETE")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        raise ClientError(f"{url}: {e.code} {e.read().decode()}",
+                          code=e.code)
+
+
+def health(url: str) -> dict:
+    return _get(url.rstrip("/") + "/health")
 
 
 def check(url: str, spec: str, cfg: str, **kw) -> dict:

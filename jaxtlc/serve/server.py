@@ -15,14 +15,23 @@ Endpoints (on top of the inherited monitor):
 
       {"name": "...", "spec": "---- MODULE M ----\\n...",
        "cfg": "CONSTANT ...", "constants": {"N": 3},
-       "sweep": {"const": "N", "lo": 1, "hi": 4},
-       "options": {"chunk": 64, "qcap": 1024, "fpcap": 4096}}
+       "tenant": "ci", "sweep": {"const": "N", "lo": 1, "hi": 4},
+       "options": {"chunk": 64, "qcap": 1024, "fpcap": 4096,
+                   "priority": 5, "deadline_s": 30}}
 
   -> 202 with the job id + the URLs to poll/stream.  Compatible sweep
   jobs batch into one vmapped dispatch; large jobs route through the
-  resil supervisor (see serve.scheduler for the discipline).
+  resil supervisor (see serve.scheduler for the discipline).  An
+  over-limit submit (queue bound / tenant quota) is **429** with a
+  ``Retry-After`` header computed from the measured drain rate.
 * ``GET /jobs`` - the job registry (state, engine, result per job).
 * ``GET /jobs/<id>`` - one job's record (the verdict lives here).
+* ``DELETE /jobs/<id>`` - cancel: a queued job flips to the terminal
+  ``canceled`` state; a running checkpointed heavy job drains through
+  the programmatic preempt path (ISSUE 17).
+* ``GET /health`` - scheduler liveness: queue depth vs bound, drain
+  rate, open breakers (``status`` flips to "overloaded" at 80% of the
+  admission bound).
 * ``GET /pool`` - engine-pool + scheduler + compile-meter stats (the
   warm/cold accounting ``tools/loadgen.py`` asserts on).
 
@@ -40,7 +49,7 @@ from typing import Optional
 
 from ..obs import serve as obs_serve
 from .pool import EnginePool
-from .scheduler import JobError, Scheduler
+from .scheduler import AdmissionError, JobError, Scheduler
 
 
 class _JobHandler(obs_serve._Handler):
@@ -67,7 +76,24 @@ class _JobHandler(obs_serve._Handler):
                 constants=body.get("constants"),
                 sweep=body.get("sweep"),
                 options=body.get("options"),
+                tenant=body.get("tenant"),
             )
+        except AdmissionError as e:
+            # admission control: 429 + the drain-rate Retry-After the
+            # client's backoff honors (serve.client)
+            payload = json.dumps({
+                "error": str(e), "retry_after": e.retry_after,
+            }).encode()
+            self.send_response(429)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("Retry-After", str(e.retry_after))
+            self.end_headers()
+            try:
+                self.wfile.write(payload)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            return
         except (JobError, ValueError) as e:
             self._send(400, f"bad job: {e}\n".encode(), "text/plain")
             return
@@ -97,10 +123,29 @@ class _JobHandler(obs_serve._Handler):
                     "pool": self.scheduler.pool.stats(),
                     "scheduler": self.scheduler.stats(),
                 }).encode(), "application/json")
+            elif route == "/health":
+                self._send(200,
+                           json.dumps(self.scheduler.health()).encode(),
+                           "application/json")
             else:
                 super().do_GET()
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-write: their call
+
+    def do_DELETE(self):  # noqa: N802
+        route = self.path.split("?", 1)[0].rstrip("/")
+        if not route.startswith("/jobs/"):
+            self._send(404, b"unknown endpoint\n", "text/plain")
+            return
+        try:
+            job = self.scheduler.cancel(route[len("/jobs/"):])
+            if job is None:
+                self._send(404, b"no such job\n", "text/plain")
+                return
+            self._send(200, json.dumps(job.summary()).encode(),
+                       "application/json")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
 
 
 class CheckServer:
@@ -110,7 +155,11 @@ class CheckServer:
     def __init__(self, root: Optional[str] = None, port: int = 0,
                  host: str = "127.0.0.1", pool: EnginePool = None,
                  pool_capacity: int = 8, sweep_width: int = None,
-                 large_fpcap: int = None, prewarm: list = None):
+                 large_fpcap: int = None, prewarm: list = None,
+                 queue_bound: int = None, tenant_quota: int = None,
+                 tenant_weights: dict = None, job_retries: int = None,
+                 breaker_threshold: int = None,
+                 breaker_cooldown_s: float = None, faults=None):
         from http.server import ThreadingHTTPServer
 
         from .scheduler import DEFAULT_LARGE_FPCAP
@@ -119,9 +168,16 @@ class CheckServer:
         os.makedirs(self.root, exist_ok=True)
         self.pool = pool or EnginePool(capacity=pool_capacity,
                                        sweep_width=sweep_width)
+        sched_kw = {k: v for k, v in dict(
+            queue_bound=queue_bound, tenant_quota=tenant_quota,
+            tenant_weights=tenant_weights, job_retries=job_retries,
+            breaker_threshold=breaker_threshold,
+            breaker_cooldown_s=breaker_cooldown_s, faults=faults,
+        ).items() if v is not None}
         self.scheduler = Scheduler(
             self.root, pool=self.pool,
             large_fpcap=large_fpcap or DEFAULT_LARGE_FPCAP,
+            **sched_kw,
         )
         if prewarm:
             # compile ahead of traffic WITHOUT blocking startup; /pool's

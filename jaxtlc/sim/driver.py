@@ -142,6 +142,7 @@ def run_sim_supervised(
     resume: bool = False,
     faults: Optional[FaultPlan] = None,
     on_event=None,
+    drain=None,
 ) -> SimSupervised:
     """Segmented walk run with preemption safety and cursor resume.
 
@@ -188,10 +189,13 @@ def run_sim_supervised(
     segments = 0
     ckpt_writes = 0
     interrupted = False
+    # the programmatic drain twin of _SignalCatcher (ISSUE 17): the
+    # serve scheduler preempts ONE sim job without signaling the server
+    drained = (lambda: drain is not None and drain.is_set())
     with _SignalCatcher() as sig:
         while not sim_done(carry, depth):
             injector.segment_start(segments)
-            if sig.hit is not None:
+            if sig.hit is not None or drained():
                 interrupted = True
                 break
             carry = jax.block_until_ready(compiled(carry))
@@ -204,10 +208,11 @@ def run_sim_supervised(
                 ckpt_writes += 1
                 _emit(on_event, "checkpoint", path=path,
                       seconds=round(time.time() - tck, 6), label="sim")
-            if sig.hit is not None:
+            if sig.hit is not None or drained():
                 interrupted = True
                 break
-        if sig.hit is not None and not sim_done(carry, depth):
+        if (sig.hit is not None or drained()) \
+                and not sim_done(carry, depth):
             interrupted = True
     wall = time.time() - t0
     if interrupted:

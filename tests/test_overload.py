@@ -1,0 +1,335 @@
+"""Overload control-plane tests (ISSUE 17) - ZERO engine compiles.
+
+Scheduling policy is host Python, so it is tested at policy speed: ONE
+module-scoped CheckServer over a STUB engine pool, with the
+scheduler's `_run_batch` replaced by a name-keyed stub runner
+(`slow:<s>-*` sleeps, `boom*` raises a deterministic non-transient
+error, `die-once*` raises a TransientFault on its first dispatch
+only).  Every request still rides the real HTTP surface - admission
+429s with Retry-After headers, DELETE cancels, /health, the sched
+journal, SSE termination - but no dispatch ever compiles or runs an
+engine, and a module-wide CompileMeter guard proves it.
+
+The real-engine halves of ISSUE 17 (supervised preemption with
+bit-for-bit resume parity, running-deadline expiry, running cancel)
+live in tests/test_service.py against its shared warm server.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jaxtlc.obs import journal as obs_journal
+from jaxtlc.resil.faults import TransientFault
+from jaxtlc.serve import client
+from jaxtlc.serve.scheduler import TERMINAL_STATES, DrainTimeout, Job
+from jaxtlc.serve.server import CheckServer
+
+OK_SPEC = ("---- MODULE OverloadOK ----\nVARIABLE x\nInit == x = 0\n"
+           "Next == x' = x\n====\n")
+BOOM_SPEC = ("---- MODULE OverloadBoom ----\nVARIABLE x\n"
+             "Init == x = 0\nNext == x' = x\n====\n")
+CFG = "SPECIFICATION\nSpec\n"
+
+QUEUE_BOUND = 3
+TENANT_QUOTA = 2
+BREAKER_THRESHOLD = 2
+BREAKER_COOLDOWN_S = 0.4
+
+
+class _StubPool:
+    """Engine-pool stand-in: policy tests must cost microseconds."""
+
+    sweep_width = 4
+
+    def stats(self):
+        return dict(hits=0, misses=0, size=0, compiles=0, entries=[])
+
+    def shutdown(self):
+        pass
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = CheckServer(
+        pool=_StubPool(), queue_bound=QUEUE_BOUND,
+        tenant_quota=TENANT_QUOTA, breaker_threshold=BREAKER_THRESHOLD,
+        breaker_cooldown_s=BREAKER_COOLDOWN_S,
+    )
+    sch = srv.scheduler
+
+    def stub_run(batch):
+        for j in batch:
+            if j.name.startswith("boom"):
+                raise ValueError("injected poison dispatch")
+            if j.name.startswith("die-once") and j.retries == 0:
+                raise TransientFault("injected runner death")
+            if j.name.startswith("slow:"):
+                time.sleep(float(j.name.split(":")[1].split("-")[0]))
+            with sch._journal(j) as jr:
+                jr.event("run_start", version="test-overload",
+                         workload=j.name, engine="stub", device="host",
+                         params={})
+                jr.event("final", verdict="ok", generated=1,
+                         distinct=1, depth=1, queue=0, wall_s=0.0,
+                         interrupted=False)
+            sch._finish_ok(j, dict(verdict="ok", engine="stub",
+                                   generated=1, distinct=1, depth=1,
+                                   wall_s=0.0))
+
+    sch._run_batch = stub_run
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_compiles(server):
+    """The whole module is policy: zero fresh XLA compiles allowed."""
+    from jaxtlc.serve.pool import xla_compiles
+
+    pre = xla_compiles()
+    yield
+    assert xla_compiles() - pre == 0, (
+        "overload policy tests compiled an engine"
+    )
+
+
+def _stall(server, secs=0.5, name="slow"):
+    """Occupy the single worker for `secs`: the deterministic window
+    every queued-state scenario needs.  Returns the stall job id."""
+    jid = client.submit(server.url, OK_SPEC, CFG,
+                        name=f"slow:{secs}-{name}")
+    deadline = time.time() + 10
+    while client.status(server.url, jid)["state"] != "running":
+        assert time.time() < deadline, "stall job never dispatched"
+        time.sleep(0.005)
+    return jid
+
+
+def _sched_events(server):
+    path = os.path.join(server.root, "sched.journal.jsonl")
+    return [e for e in obs_journal.read(path) if e["event"] == "sched"]
+
+
+def _raw_submit(url, payload):
+    req = urllib.request.Request(
+        url.rstrip("/") + "/jobs", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, dict(r.headers), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# admission control: bound, 429 + Retry-After, client backoff
+
+
+def test_admission_429_with_retry_after(server):
+    stall = _stall(server, 0.5, "admission")
+    fills = [
+        client.submit(server.url, OK_SPEC, CFG, name=f"fill-{i}",
+                      tenant=t)
+        for i, t in enumerate(("alpha", "beta", "alpha"))
+    ]
+    # over the bound: the raw HTTP response is a 429 whose
+    # Retry-After header the stdlib client can parse
+    code, headers, body = _raw_submit(server.url, {
+        "spec": OK_SPEC, "cfg": CFG, "name": "over-bound",
+        "tenant": "gamma",
+    })
+    assert code == 429
+    assert int(headers["Retry-After"]) >= 1
+    payload = json.loads(body)
+    assert payload["retry_after"] == int(headers["Retry-After"])
+    assert "queue full" in payload["error"]
+    # the client surface: retries=0 raises with the hint attached...
+    with pytest.raises(client.ClientError) as ei:
+        client.submit(server.url, OK_SPEC, CFG, name="over-bound-2",
+                      tenant="gamma", retries=0)
+    assert ei.value.code == 429
+    assert ei.value.retry_after >= 1
+    # ...and the default backoff retries until capacity frees
+    landed = client.submit(server.url, OK_SPEC, CFG, name="backoff-in",
+                           tenant="gamma")
+    for jid in fills + [stall, landed]:
+        assert client.wait(server.url, jid, timeout=30)["state"] == "done"
+
+
+def test_tenant_quota_and_wrr_fairness(server):
+    stall = _stall(server, 0.5, "wrr")
+    hog1 = client.submit(server.url, OK_SPEC, CFG, name="hog-1",
+                         tenant="hog")
+    hog2 = client.submit(server.url, OK_SPEC, CFG, name="hog-2",
+                         tenant="hog")
+    with pytest.raises(client.ClientError) as ei:
+        client.submit(server.url, OK_SPEC, CFG, name="hog-3",
+                      tenant="hog", retries=0)
+    assert ei.value.code == 429  # per-tenant quota, queue NOT full
+    meek = client.submit(server.url, OK_SPEC, CFG, name="meek-1",
+                         tenant="meek")
+    for jid in (stall, hog1, hog2, meek):
+        assert client.wait(server.url, jid, timeout=30)["state"] == "done"
+    # weighted round-robin: the meek tenant is served within the first
+    # rotation, never starved behind the hog's whole backlog
+    order = [e["job"] for e in _sched_events(server)
+             if e["action"] == "dispatch"
+             and e["job"] in (hog1, hog2, meek)]
+    assert len(order) == 3
+    assert order.index(meek) < 2, f"meek starved: {order}"
+
+
+# ---------------------------------------------------------------------------
+# deadlines, cancel, priorities
+
+
+def test_queued_deadline_expires(server):
+    stall = _stall(server, 0.4, "deadline")
+    jid = client.submit(server.url, OK_SPEC, CFG, name="doomed",
+                        options={"deadline_s": 0.05})
+    st = client.wait(server.url, jid, timeout=10)
+    assert st["state"] == "expired"
+    assert st["deadline_s"] == 0.05
+    assert "deadline" in st["error"]
+    # a never-ran job still journals (run_start engine="sched" +
+    # final) so /runs lists it and an SSE follower terminates; the
+    # new terminal verdict validates against schema v1
+    events = obs_journal.read(
+        os.path.join(server.root, f"{jid}.journal.jsonl"))
+    assert events[0]["engine"] == "sched"
+    assert events[-1]["event"] == "final"
+    assert events[-1]["verdict"] == "expired"
+    sse = list(client.stream(server.url, jid, timeout=10))
+    assert sse[-1]["event"] == "final"
+    assert sse[-1]["verdict"] == "expired"
+    assert client.wait(server.url, stall, timeout=30)["state"] == "done"
+
+
+def test_cancel_queued_and_delete_404(server):
+    stall = _stall(server, 0.4, "cancel")
+    jid = client.submit(server.url, OK_SPEC, CFG, name="regret")
+    st = client.cancel(server.url, jid)
+    assert st["state"] == "canceled"
+    assert client.status(server.url, jid)["state"] == "canceled"
+    with pytest.raises(client.ClientError) as ei:
+        client.cancel(server.url, "no-such-job")
+    assert ei.value.code == 404
+    # Job.state's docstring documents the full state machine,
+    # scheduler-terminal states included
+    for state in ("queued", "running") + TERMINAL_STATES:
+        assert state in Job.__doc__, f"Job docstring lost {state!r}"
+    assert client.wait(server.url, stall, timeout=30)["state"] == "done"
+
+
+def test_priority_dispatch_order(server):
+    stall = _stall(server, 0.4, "priority")
+    lo = client.submit(server.url, OK_SPEC, CFG, name="prio-lo",
+                       options={"priority": 0})
+    hi = client.submit(server.url, OK_SPEC, CFG, name="prio-hi",
+                       options={"priority": 5})
+    for jid in (stall, lo, hi):
+        assert client.wait(server.url, jid, timeout=30)["state"] == "done"
+    order = [e["job"] for e in _sched_events(server)
+             if e["action"] == "dispatch" and e["job"] in (lo, hi)]
+    assert order == [hi, lo], "higher priority did not dispatch first"
+
+
+# ---------------------------------------------------------------------------
+# retry + circuit breaker
+
+
+def test_transient_dispatch_retries_to_done(server):
+    jid = client.submit(server.url, OK_SPEC, CFG, name="die-once-a")
+    st = client.wait(server.url, jid, timeout=30)
+    assert st["state"] == "done"
+    assert st["retries"] == 1
+    retries = [e for e in _sched_events(server)
+               if e["action"] == "retry" and e["job"] == jid]
+    assert len(retries) == 1
+    assert retries[0]["attempt"] == 1
+    assert retries[0]["delay_s"] > 0
+    assert "TransientFault" in retries[0]["error"]
+
+
+def test_breaker_trip_cooldown_half_open(server):
+    # two deterministic failures of one spec digest trip the breaker
+    for i in (1, 2):
+        st = client.check(server.url, BOOM_SPEC, CFG, name=f"boom-{i}")
+        assert st["state"] == "error"
+    assert client.health(server.url)["open_breakers"] == 1
+    # open circuit: the next submit of that digest never runs
+    st = client.check(server.url, BOOM_SPEC, CFG, name="boom-3")
+    assert st["state"] == "quarantined"
+    assert "circuit open" in st["error"]
+    sse = list(client.stream(server.url, st["id"], timeout=10))
+    assert sse[-1]["verdict"] == "quarantined"
+    # other digests are untouched by the open breaker
+    ok = client.check(server.url, OK_SPEC, CFG, name="bystander")
+    assert ok["state"] == "done"
+    time.sleep(BREAKER_COOLDOWN_S + 0.05)
+    # cooldown elapsed: exactly ONE half-open probe runs; a second
+    # submit while the probe is in flight stays quarantined
+    probe = client.submit(server.url, BOOM_SPEC, CFG,
+                          name="slow:0.3-probe")
+    held = client.check(server.url, BOOM_SPEC, CFG, name="held-back")
+    assert held["state"] == "quarantined"
+    assert client.wait(server.url, probe, timeout=30)["state"] == "done"
+    # the succeeding probe closed the circuit
+    assert client.health(server.url)["open_breakers"] == 0
+    st = client.check(server.url, BOOM_SPEC, CFG, name="ok-again")
+    assert st["state"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# drain, surfaces
+
+
+def test_drain_timeout_is_loud(server):
+    jid = client.submit(server.url, OK_SPEC, CFG, name="slow:0.6-drain")
+    with pytest.raises(DrainTimeout) as ei:
+        server.scheduler.drain(timeout=0.05)
+    assert jid in ei.value.pending
+    assert jid in str(ei.value)
+    assert client.wait(server.url, jid, timeout=30)["state"] == "done"
+    assert server.scheduler.drain(timeout=10) is True
+
+
+def test_health_stats_and_metrics_surfaces(server):
+    h = client.health(server.url)
+    assert h["status"] == "ok"
+    assert h["queued"] == 0 and h["running"] == []
+    assert h["uptime_s"] > 0
+    for k in ("admitted", "rejected", "expired", "canceled",
+              "quarantined", "retried"):
+        assert h["counters"][k] >= 1, k
+    stats = client.pool_stats(server.url)["scheduler"]
+    assert stats["queue_bound"] == QUEUE_BOUND
+    assert stats["tenant_quota"] == TENANT_QUOTA
+    assert stats["dispatches"] >= 1
+    assert stats["sched"] == h["counters"]
+    # every control-plane decision renders as a Prometheus gauge off
+    # the sched journal (obs.views.metrics_from_events)
+    with urllib.request.urlopen(
+        server.url + "/metrics?run=sched", timeout=10
+    ) as r:
+        text = r.read().decode()
+    for needle in ("sched_admit_total", "sched_reject_total",
+                   "sched_expire_total", "sched_retry_total",
+                   "sched_quarantine_total", "sched_cancel_total",
+                   "sched_queue_depth"):
+        assert needle in text, f"/metrics lost {needle}:\n{text}"
+    # the scheduler's own journal is schema-valid end to end
+    events = obs_journal.read(
+        os.path.join(server.root, "sched.journal.jsonl"))
+    assert events[0]["event"] == "run_start"
+    assert events[0]["engine"] == "sched"
+    # every job the module created reached a terminal state: the
+    # queue never wedged
+    assert all(j["state"] in TERMINAL_STATES
+               for j in server.scheduler.list())

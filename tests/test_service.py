@@ -25,6 +25,7 @@ Pinned here:
 import io
 import json
 import os
+import time
 
 import pytest
 
@@ -575,3 +576,141 @@ def test_journal_batched_fsync(tmp_path, monkeypatch):
         j2.event("progress", depth=1, generated=1, distinct=1, queue=0)
         j2.event("progress", depth=2, generated=2, distinct=2, queue=0)
     assert len(syncs) == 2
+
+
+# ---------------------------------------------------------------------------
+# overload control plane on the REAL supervised path (ISSUE 17): the
+# policy-speed scheduler tests live in tests/test_overload.py against a
+# stub pool; these three pin the parts only a real engine can prove -
+# drain-at-segment-fence preemption with bit-for-bit resume parity,
+# running-deadline expiry, and running cancel.  The LoadChain spec and
+# heavy geometry are byte-identical to tools/loadgen.py so struct.cache
+# memoizes ONE supervised compile across the whole pytest process.
+# ---------------------------------------------------------------------------
+
+_CHAIN_SPEC = """---- MODULE LoadChain ----
+EXTENDS Naturals
+CONSTANTS MAX
+VARIABLES x
+
+Init == x = 0
+
+Up == /\\ x < MAX
+      /\\ x' = x + 1
+
+Next == Up
+
+Spec == Init /\\ [][Next]_x
+
+InRange == x <= MAX
+====
+"""
+
+_CHAIN_CFG = """CONSTANT MAX = 600
+SPECIFICATION
+Spec
+INVARIANT
+InRange
+"""
+
+# the `checkpoint` option alone routes the job supervised (it is a
+# _HEAVY_OPTIONS member) while the tiny fpcap keeps checkpoints ~KB;
+# checkpointevery=8 puts a drain fence every 8 of the 600 levels
+_HEAVY = dict(chunk=16, qcap=256, fpcap=1024, nodeadlock=True,
+              checkpointevery=8, noartifactcache=True)
+
+
+def _wait_running(url, jid, timeout=30.0):
+    deadline = time.time() + timeout
+    while True:
+        st = client.status(url, jid)
+        if st["state"] == "running":
+            return st
+        assert st["state"] == "queued", st
+        assert time.time() < deadline, f"{jid} never started running"
+        time.sleep(0.005)
+
+
+def test_priority_preemption_resume_bit_for_bit(server, tmp_path):
+    """A high-priority arrival drains the running checkpointed job at
+    the next segment fence (checkpoint + exit 75); the preempted job
+    requeues as a -recover resume and its final counters match an
+    uninterrupted run of the same spec EXACTLY (the PR 2/7 resume
+    contract, now exercised by the scheduler itself)."""
+    url = server.url
+    ref = client.check(
+        url, _CHAIN_SPEC, _CHAIN_CFG, name="preempt-ref",
+        options=dict(_HEAVY, checkpoint=str(tmp_path / "ref.npz")),
+        timeout=600,
+    )
+    assert ref["state"] == "done", ref
+    assert ref["result"]["verdict"] == "ok"
+
+    low = {}
+    for attempt in range(3):  # preemption needs the low job mid-run
+        lo_id = client.submit(
+            url, _CHAIN_SPEC, _CHAIN_CFG, name=f"preempt-lo{attempt}",
+            options=dict(_HEAVY, priority=0,
+                         checkpoint=str(tmp_path / f"lo{attempt}.npz")),
+        )
+        _wait_running(url, lo_id)
+        hi_id = client.submit(
+            url, _CHAIN_SPEC, _CHAIN_CFG, name=f"preempt-hi{attempt}",
+            options=dict(_HEAVY, priority=10,
+                         checkpoint=str(tmp_path / f"hi{attempt}.npz")),
+        )
+        low = client.wait(url, lo_id, timeout=600)
+        hi = client.wait(url, hi_id, timeout=600)
+        assert hi["state"] == "done", hi
+        if low.get("requeues", 0) >= 1:
+            break
+    assert low["state"] == "done", low
+    assert low["requeues"] >= 1, "high-priority arrival never preempted"
+    assert low["options"]["recover"] is True  # resumed as -recover
+    for k in ("generated", "distinct", "depth", "violation",
+              "action_generated"):
+        assert low["result"][k] == ref["result"][k], (
+            k, low["result"], ref["result"])
+    # the scheduler journaled the preempt -> requeue pair
+    from jaxtlc.obs import journal as obs_journal
+    sched = [e for e in obs_journal.read(
+        os.path.join(server.root, "sched.journal.jsonl"))
+        if e["event"] == "sched" and e.get("job") == low["id"]]
+    assert any(e["action"] == "preempt" and e["reason"] == "priority"
+               for e in sched)
+    assert any(e["action"] == "requeue" and e["requeues"] == 1
+               for e in sched)
+
+
+def test_running_deadline_drains_to_expired(server, tmp_path):
+    """Deadline hits while the job is RUNNING: the reaper sets its
+    drain Event, the supervisor checkpoints at the next fence and
+    exits 75, and the job lands `expired` with its partial progress
+    attached - not killed mid-step, not left running past its
+    deadline."""
+    st = client.check(
+        server.url, _CHAIN_SPEC, _CHAIN_CFG, name="deadline-run",
+        options=dict(_HEAVY, deadline_s=0.3,
+                     checkpoint=str(tmp_path / "dl.npz")),
+        timeout=600,
+    )
+    assert st["state"] == "expired", st
+    assert "deadline expired while running" in st["error"]
+    assert st["result"]["exit_code"] == 75
+    assert 0 < st["result"]["depth"] < 600  # partial progress attached
+
+
+def test_cancel_running_job_drains_to_canceled(server, tmp_path):
+    """DELETE /jobs/<id> on a RUNNING checkpointed job rides the same
+    drain path: checkpoint at the next fence, exit 75, terminal
+    `canceled`."""
+    jid = client.submit(
+        server.url, _CHAIN_SPEC, _CHAIN_CFG, name="cancel-run",
+        options=dict(_HEAVY, checkpoint=str(tmp_path / "cx.npz")),
+    )
+    _wait_running(server.url, jid)
+    client.cancel(server.url, jid)
+    st = client.wait(server.url, jid, timeout=600)
+    assert st["state"] == "canceled", st
+    assert "canceled by client" in st["error"]
+    assert st["result"]["exit_code"] == 75

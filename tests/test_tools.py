@@ -293,3 +293,41 @@ def test_committed_bench_payloads_have_required_fields():
                 f"{os.path.basename(path)} payload lost {field!r}: "
                 f"{payload}"
             )
+
+
+def test_loadgen_overload_tiny_smoke(capsys):
+    """tools/loadgen.py --overload --tiny: a real checking service
+    with a small admission bound under deliberate overload - warm
+    latency gate (zero fresh compiles), a supervised heavy job
+    preempted by a priority arrival and resumed bit-for-bit, a burst
+    past the queue bound rejected 429 + Retry-After with the client
+    backoff landing the resubmit, one deadline expiry + one cancel
+    (the ISSUE 17 acceptance instrument)."""
+    mod = _load_tool("loadgen")
+    assert mod.main(["--overload", "--tiny"]) == 0
+    out = capsys.readouterr().out
+    assert "loadgen OK" in out, out
+    report = json.loads(out[: out.index("loadgen OK")])
+    assert report["warm_fresh_xla_compiles"] == 0
+    assert report["burst"]["rejected"] >= 1
+    assert report["burst"]["retry_after_s"][0] >= 1  # [min, max] hints
+    assert report["burst"]["accepted"] + report["burst"]["rejected"] \
+        == report["burst"]["submitted"]
+    assert report["preempt"]["requeues"] >= 1
+    assert report["preempt"]["parity"] is True
+    assert report["expired"] == 1 and report["canceled"] == 1
+    assert report["counters"]["rejected"] >= 1
+    assert report["warm_p50_s"] <= report["warm_p95_s"]
+
+
+def test_chaos_serve_tiny_smoke(capsys):
+    """tools/chaos.py --serve --tiny: the scheduler chaos matrix on a
+    stub pool - runner_die absorbed by retry, slow_dispatch creating
+    the overload window for 429 / deadline expiry / cancel, a poison
+    spec tripping the breaker into quarantine, SSE followers
+    terminating on every outcome, queue drained clean (engine-free,
+    policy-speed)."""
+    mod = _load_tool("chaos")
+    assert mod.main(["--serve", "--tiny"]) == 0
+    out = capsys.readouterr().out
+    assert "chaos serve OK" in out, out

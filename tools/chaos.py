@@ -17,6 +17,10 @@ Usage:
     python tools/chaos.py --matrix --tiny # degradation-ladder matrix:
                                           # every rung of the capacity
                                           # ladder pinned bit-for-bit
+    python tools/chaos.py --serve --tiny  # serving-layer matrix: the
+                                          # scheduler's overload paths
+                                          # under injected faults
+                                          # (ISSUE 17; zero compiles)
 
 The smoke mode is wired into tier-1 (tests/test_resil.py::test_chaos_smoke)
 and the ladder matrix into tests/test_spill.py, so every recovery path
@@ -327,6 +331,226 @@ def run_matrix(tiny: bool = True, verbose: bool = True,
     return 0, details
 
 
+def run_serve(tiny: bool = True, verbose: bool = True) -> int:
+    """The serving-layer chaos matrix (ISSUE 17): a real CheckServer
+    over a STUB runner (no engines, ZERO XLA compiles) with scheduler
+    faults injected - `runner_die@N` kills a dispatch with a transient
+    fault the retry classification must absorb, `slow_dispatch@N`
+    stalls the worker to open a deterministic overload window - and
+    the whole outcome matrix driven through the real HTTP surface:
+    retry-to-done, queued-deadline expiry, admission 429, cancel,
+    breaker quarantine.  The three liveness invariants under test:
+
+    * the queue never wedges - every admitted job reaches a terminal
+      state and a post-storm drain() completes;
+    * every rejection is a 429 carrying a Retry-After hint;
+    * an SSE follower terminates on EVERY outcome class (done /
+      expired / canceled / quarantined), because even never-ran jobs
+      get a minimal journal with a final event.
+    """
+    import threading
+    import time
+
+    from jaxtlc.obs import journal as obs_journal
+    from jaxtlc.serve import client
+    from jaxtlc.serve.scheduler import TERMINAL_STATES
+    from jaxtlc.serve.server import CheckServer
+
+    def say(msg):
+        if verbose:
+            print(f"[chaos-serve] {msg}", flush=True)
+
+    SPEC = ("---- MODULE ServeChaos ----\nVARIABLE x\nInit == x = 0\n"
+            "Next == x' = x\n====\n")
+    CFG = "SPECIFICATION\nSpec\n"
+    POISON_SPEC = ("---- MODULE ServePoison ----\nVARIABLE x\n"
+                   "Init == x = 0\nNext == x' = x\n====\n")
+
+    class _StubPool:
+        """Engine-pool stand-in: the chaos matrix tests scheduling
+        POLICY, so dispatches must cost microseconds, not compiles."""
+
+        sweep_width = 4
+
+        def stats(self):
+            return dict(hits=0, misses=0, size=0, compiles=0,
+                        entries=[])
+
+        def shutdown(self):
+            pass
+
+    failures = []
+    srv = CheckServer(
+        pool=_StubPool(), queue_bound=3, breaker_threshold=2,
+        breaker_cooldown_s=3600.0,
+        faults="runner_die@2,slow_dispatch@4",
+    )
+    sch = srv.scheduler
+    sch._injector.slow_dispatch_s = 1.0  # the overload window
+
+    def stub_run(batch):
+        for j in batch:
+            if j.name.startswith("poison"):
+                raise ValueError("injected poison dispatch")
+            with sch._journal(j) as jr:
+                jr.event("run_start", version="chaos-serve",
+                         workload=j.name, engine="stub", device="host",
+                         params={})
+                jr.event("final", verdict="ok", generated=1,
+                         distinct=1, depth=1, queue=0, wall_s=0.0,
+                         interrupted=False)
+            sch._finish_ok(j, dict(verdict="ok", engine="stub",
+                                   generated=1, distinct=1, depth=1,
+                                   wall_s=0.0))
+
+    sch._run_batch = stub_run
+
+    verdicts = {}
+
+    def follow(job_id):
+        """SSE follower: retries until the job's journal exists (a
+        never-ran job only gets one at its terminal transition), then
+        records the final verdict.  MUST terminate - that is the
+        invariant under test."""
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                for ev in client.stream(srv.url, job_id, timeout=30):
+                    if ev.get("event") == "final":
+                        verdicts[job_id] = ev["verdict"]
+                        return
+            except Exception:
+                time.sleep(0.02)
+        verdicts[job_id] = None  # follower wedged
+
+    followers = []
+
+    def check(cond, what):
+        if not cond:
+            failures.append(what)
+            say(f"FAIL {what}")
+
+    try:
+        # dispatch 1: a clean job through the stub runner
+        say("clean stub job...")
+        a = client.check(srv.url, SPEC, CFG, name="serve-a")
+        check(a["state"] == "done", "clean(job not done)")
+
+        # dispatch 2 = runner_die -> retry -> dispatch 3 completes
+        say("runner_die@2: dispatch dies, retry must absorb it...")
+        b = client.check(srv.url, SPEC, CFG, name="serve-b")
+        check(b["state"] == "done", "retry(job not done)")
+        check(b.get("retries") == 1,
+              f"retry(retries={b.get('retries')}, want 1)")
+
+        # dispatch 4 = slow_dispatch: the worker stalls 1 s - the
+        # deterministic overload window for deadline/admission/cancel
+        say("slow_dispatch@4: stall the worker, storm the queue...")
+        c_id = client.submit(srv.url, SPEC, CFG, name="serve-c")
+        dl = time.time() + 10
+        while client.status(srv.url, c_id)["state"] != "running":
+            check(time.time() < dl, "window(dispatch never started)")
+            if failures:
+                break
+            time.sleep(0.005)
+        d_id = client.submit(srv.url, SPEC, CFG, name="serve-d",
+                             options={"deadline_s": 0.3})
+        e_id = client.submit(srv.url, SPEC, CFG, name="serve-e")
+        f_id = client.submit(srv.url, SPEC, CFG, name="serve-f")
+        for jid in (c_id, d_id, e_id):
+            t = threading.Thread(target=follow, args=(jid,),
+                                 daemon=True)
+            t.start()
+            followers.append(t)
+        # queue is at the bound: the next submit must be a 429
+        try:
+            client.submit(srv.url, SPEC, CFG, name="serve-g",
+                          retries=0)
+            check(False, "admission(over-bound submit accepted)")
+        except client.ClientError as e:
+            check(e.code == 429, f"admission(code={e.code})")
+            check((e.retry_after or 0) >= 1,
+                  f"admission(retry_after={e.retry_after})")
+        h = client.health(srv.url)
+        check(h["status"] == "overloaded",
+              f"health(status={h['status']} under full queue)")
+        canceled = client.cancel(srv.url, e_id)
+        check(canceled["state"] == "canceled",
+              f"cancel(state={canceled['state']})")
+        d = client.wait(srv.url, d_id, timeout=10)
+        check(d["state"] == "expired", f"deadline(state={d['state']})")
+        c = client.wait(srv.url, c_id, timeout=10)
+        check(c["state"] == "done", f"window(c state={c['state']})")
+        f = client.wait(srv.url, f_id, timeout=10)
+        check(f["state"] == "done", f"window(f state={f['state']})")
+
+        # breaker: two poison dispatches trip the digest breaker; the
+        # third submit of the same spec is quarantined WITHOUT running
+        say("poison spec: trip the breaker, quarantine the third...")
+        for i in (1, 2):
+            p = client.check(srv.url, POISON_SPEC, CFG,
+                             name=f"poison-{i}")
+            check(p["state"] == "error", f"poison-{i}({p['state']})")
+        q = client.check(srv.url, POISON_SPEC, CFG, name="poison-3")
+        check(q["state"] == "quarantined",
+              f"quarantine(state={q['state']})")
+        t = threading.Thread(target=follow, args=(q["id"],),
+                             daemon=True)
+        t.start()
+        followers.append(t)
+
+        # post-storm: the queue must still schedule, across tenants
+        say("post-storm drain across two tenants...")
+        ids = [client.submit(srv.url, SPEC, CFG, name=f"post-{i}",
+                             tenant=("ci" if i % 2 else "dev"))
+               for i in range(4)]
+        for jid in ids:
+            st = client.wait(srv.url, jid, timeout=10)
+            check(st["state"] == "done", f"post({jid}={st['state']})")
+
+        check(sch.drain(timeout=10) is True, "drain(did not complete)")
+        h = client.health(srv.url)
+        check(h["status"] == "ok" and h["queued"] == 0,
+              f"health(end={h['status']}/{h['queued']})")
+        for k in ("rejected", "expired", "canceled", "quarantined",
+                  "retried"):
+            check(h["counters"][k] >= 1, f"counters({k}=0)")
+        nonterminal = [j["id"] for j in sch.list()
+                       if j["state"] not in TERMINAL_STATES]
+        check(not nonterminal, f"wedge(nonterminal={nonterminal})")
+
+        for t in followers:
+            t.join(timeout=30)
+        check(not any(t.is_alive() for t in followers),
+              "sse(a follower never terminated)")
+        want = {c_id: "ok", d_id: "expired", e_id: "canceled",
+                q["id"]: "quarantined"}
+        for jid, v in want.items():
+            check(verdicts.get(jid) == v,
+                  f"sse({jid}: {verdicts.get(jid)} != {v})")
+        sched_journal = os.path.join(srv.root, "sched.journal.jsonl")
+    finally:
+        srv.shutdown()
+
+    # the control plane's own journal is schema-valid and carries
+    # every decision class this storm exercised
+    events = obs_journal.read(sched_journal)
+    actions = {e["action"] for e in events if e["event"] == "sched"}
+    missing = {"admit", "dispatch", "retry", "reject", "expire",
+               "cancel", "quarantine"} - actions
+    if missing:
+        failures.append(f"journal(missing actions {sorted(missing)})")
+        say(f"FAIL journal(missing actions {sorted(missing)})")
+
+    if failures:
+        say(f"FAILURES: {failures}")
+        return 1
+    say("chaos serve OK: retry absorbed, deadline expired, 429 + "
+        "Retry-After on overload, cancel + quarantine terminal, SSE "
+        "followers terminated on every outcome, queue drained clean")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="fault-injection chaos driver for the run supervisor"
@@ -337,12 +561,20 @@ def main(argv=None) -> int:
                    help="degradation-ladder matrix: deny each capacity-"
                         "recovery step by fault injection, verify the "
                         "next rung lands bit-for-bit on clean stats")
+    p.add_argument("--serve", action="store_true",
+                   help="serving-layer matrix (ISSUE 17): scheduler "
+                        "fault injection (runner_die, slow_dispatch) "
+                        "against a stub runner - retry, deadline, "
+                        "admission 429, cancel, quarantine, SSE "
+                        "termination; ZERO XLA compiles")
     p.add_argument("--tiny", action="store_true",
-                   help="with --matrix: the FF-corner tier-1 wiring")
+                   help="with --matrix/--serve: the tier-1 wiring")
     p.add_argument("--plan", default="",
                    help="extra fault plan DSL for a custom scenario")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
+    if args.serve:
+        return run_serve(tiny=args.tiny, verbose=not args.quiet)
     if args.matrix:
         rc, _ = run_matrix(tiny=args.tiny, verbose=not args.quiet)
         return rc

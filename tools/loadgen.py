@@ -61,6 +61,35 @@ InRange
 """
 
 
+# a long-running chain model (depth = MAX+1 levels): the overload
+# mode's "heavy" job class - wide enough in time for deterministic
+# preemption windows, tiny in state space
+_SLOW_SPEC = """---- MODULE LoadChain ----
+EXTENDS Naturals
+CONSTANTS MAX
+VARIABLES x
+
+Init == x = 0
+
+Up == /\\ x < MAX
+      /\\ x' = x + 1
+
+Next == Up
+
+Spec == Init /\\ [][Next]_x
+
+InRange == x <= MAX
+====
+"""
+
+_SLOW_CFG = """CONSTANT MAX = 600
+SPECIFICATION
+Spec
+INVARIANT
+InRange
+"""
+
+
 def _pct(xs, q):
     xs = sorted(xs)
     if not xs:
@@ -327,6 +356,207 @@ def run_infer_load(url: str, jobs: int, in_process: bool,
     return report
 
 
+def run_overload(url: str, jobs: int, in_process: bool,
+                 tiny: bool = False, out=sys.stdout) -> dict:
+    """The --overload mode (ISSUE 17): the service under sustained
+    over-capacity load.  Phases:
+
+    1. clean warm latency - the regression gate against the PR 12
+       54 ms warm-submit baseline (zero fresh XLA compiles asserted);
+    2. priority preemption - a low-priority checkpointed heavy job is
+       preempted by a high-priority arrival, requeued as a -recover
+       resume, and its final counters must be BIT-FOR-BIT the
+       uninterrupted reference run's (the PR 2/7 contract);
+    3. the storm - a heavy "plug" job occupies the worker while a
+       burst overruns the admission bound: every rejection must be a
+       429 with a Retry-After hint, every accepted job must reach a
+       terminal state, a deadlined job expires, a canceled job
+       cancels, and a rejected submit resubmitted through the client
+       backoff eventually lands;
+    4. (full mode) the mixed classes - smoke, sweep, infer, and
+       artifact-cache hits - ride the same overloaded server.
+
+    Wants a server with a SMALL admission bound (the in-process
+    default here is queue_bound=4; external servers should be started
+    with --queue-bound 4)."""
+    import os
+    import tempfile
+
+    from jaxtlc.serve import client
+    from jaxtlc.serve.pool import xla_compiles
+
+    opts = dict(chunk=16, qcap=256, fpcap=1024, noartifactcache=True)
+    heavy = dict(chunk=16, qcap=256, fpcap=1024, nodeadlock=True,
+                 checkpointevery=8, noartifactcache=True)
+    ckdir = tempfile.mkdtemp(prefix="jaxtlc-loadgen-overload-")
+
+    bound = client.pool_stats(url)["scheduler"]["queue_bound"]
+    assert bound <= 32, (
+        f"--overload wants a small admission bound (queue_bound="
+        f"{bound}); start the server with --queue-bound 4"
+    )
+
+    # -- 1. clean warm latency -------------------------------------------
+    t0 = time.time()
+    cold = client.check(url, _SPEC, _CFG, name="over-cold",
+                        options=opts)
+    cold_s = time.time() - t0
+    assert cold["state"] == "done", cold
+    assert cold["result"]["verdict"] == "ok", cold
+    warm_lat = []
+    pre = xla_compiles() if in_process else None
+    for i in range(max(0, jobs - 1)):
+        t0 = time.time()
+        st = client.check(url, _SPEC, _CFG, name=f"over-warm-{i}",
+                          options=opts)
+        warm_lat.append(time.time() - t0)
+        assert st["state"] == "done", st
+        assert st["result"]["pool_hit"] is True, st
+    fresh = (xla_compiles() - pre) if in_process else 0
+    assert fresh == 0, f"warm path paid {fresh} fresh XLA compiles"
+
+    # -- 2. preemption + bit-for-bit resume ------------------------------
+    ref = client.check(
+        url, _SLOW_SPEC, _SLOW_CFG, name="over-ref",
+        options=dict(heavy, checkpoint=os.path.join(ckdir, "ref.npz")),
+        timeout=600,
+    )
+    assert ref["state"] == "done", ref
+    assert ref["result"]["verdict"] == "ok", ref
+
+    low = {}
+    attempts = 0
+    for attempt in range(3):
+        attempts = attempt + 1
+        low_id = client.submit(
+            url, _SLOW_SPEC, _SLOW_CFG, name=f"over-low-{attempt}",
+            options=dict(heavy, priority=0, checkpoint=os.path.join(
+                ckdir, f"low{attempt}.npz")),
+        )
+        deadline = time.time() + 120
+        while client.status(url, low_id)["state"] == "queued":
+            assert time.time() < deadline, "heavy job never started"
+            time.sleep(0.005)
+        hi = client.check(url, _SPEC, _CFG, name=f"over-hi-{attempt}",
+                          options=dict(opts, priority=10))
+        assert hi["state"] == "done", hi
+        low = client.wait(url, low_id, timeout=600)
+        assert low["state"] == "done", low
+        if low.get("requeues", 0) >= 1:
+            break
+    assert low.get("requeues", 0) >= 1, (
+        f"preemption never landed in {attempts} attempt(s): {low}"
+    )
+    for k in ("generated", "distinct", "depth", "violation",
+              "action_generated"):
+        assert low["result"][k] == ref["result"][k], (
+            f"resumed {k} diverged: {low['result'][k]} != "
+            f"{ref['result'][k]}"
+        )
+
+    # -- 3. the storm ----------------------------------------------------
+    plug_id = client.submit(
+        url, _SLOW_SPEC, _SLOW_CFG, name="over-plug",
+        options=dict(heavy, checkpoint=os.path.join(ckdir, "plug.npz")),
+    )
+    deadline = time.time() + 120
+    while client.status(url, plug_id)["state"] == "queued":
+        assert time.time() < deadline, "plug job never started"
+        time.sleep(0.005)
+    # the worker is pinned for the plug's whole wall: a deterministic
+    # overload window
+    exp_id = client.submit(url, _SPEC, _CFG, name="over-deadline",
+                           options=dict(opts, deadline_s=0.25))
+    can_id = client.submit(url, _SPEC, _CFG, name="over-cancel",
+                           options=opts)
+    assert client.cancel(url, can_id)["state"] == "canceled"
+    accepted, rejections = [], []
+    for i in range(bound + 6):
+        try:
+            accepted.append(
+                client.submit(url, _SPEC, _CFG, name=f"over-burst-{i}",
+                              options=opts, retries=0)
+            )
+        except client.ClientError as e:
+            assert e.code == 429, f"rejection was {e.code}, not 429"
+            assert (e.retry_after or 0) >= 1, (
+                f"429 without a usable Retry-After: {e.retry_after}"
+            )
+            rejections.append(e.retry_after)
+    assert rejections, "overload burst produced no 429 rejections"
+    # a rejected submit THROUGH the client's 429 backoff must land
+    t0 = time.time()
+    retry_id = client.submit(url, _SPEC, _CFG, name="over-retry",
+                             options=opts, retries=6)
+    resubmit_s = time.time() - t0
+    for jid in accepted + [plug_id, retry_id]:
+        st = client.wait(url, jid, timeout=600)
+        assert st["state"] == "done", st
+    exp = client.wait(url, exp_id, timeout=30)
+    assert exp["state"] == "expired", exp
+
+    # -- 4. the mixed classes (full mode) --------------------------------
+    mixed = {}
+    if not tiny:
+        sim = client.check(
+            url, _SPEC, _CFG, name="over-sim",
+            options=dict(simulate=True, walkers=16, depth=32,
+                         fpcap=1024, nodeadlock=True, simseed=7),
+        )
+        assert sim["state"] == "done", sim
+        assert sim["result"]["engine"] == "sim", sim
+        sweep_ids = [
+            client.submit(url, _SPEC, _CFG, name=f"over-sweep-{v}",
+                          constants={"MAX": 1 + (v % 4)},
+                          sweep={"const": "MAX", "lo": 1, "hi": 4},
+                          options=opts)
+            for v in range(4)
+        ]
+        sweeps = [client.wait(url, i, timeout=600) for i in sweep_ids]
+        assert all(s["state"] == "done"
+                   and s["result"]["engine"] == "sweep"
+                   for s in sweeps), sweeps
+        inf = client.check(
+            url, _SPEC, _CFG, name="over-infer",
+            options=dict(infer=True, inferbudget=16, walkers=16,
+                         depth=32, nodeadlock=True, simseed=0),
+        )
+        assert inf["state"] == "done", inf
+        assert inf["result"]["engine"] == "infer", inf
+        mixed["mixed_classes"] = dict(sim="done", sweep=len(sweeps),
+                                      infer="done")
+        if in_process:
+            cache_opts = dict(chunk=16, qcap=256, fpcap=1024)
+            c0 = client.check(url, _SPEC, _CFG, name="over-cache-0",
+                              options=cache_opts)
+            c1 = client.check(url, _SPEC, _CFG, name="over-cache-1",
+                              options=cache_opts)
+            assert c1["result"]["engine"] == "cache", c1
+            mixed["mixed_classes"]["cache"] = "hit"
+
+    h = client.health(url)
+    assert h["status"] == "ok" and h["queued"] == 0, h
+    stats = client.pool_stats(url)
+    report = dict(
+        jobs=jobs, queue_bound=bound,
+        cold_s=round(cold_s, 4),
+        warm_p50_s=round(_pct(warm_lat, 0.50), 4),
+        warm_p95_s=round(_pct(warm_lat, 0.95), 4),
+        warm_fresh_xla_compiles=fresh,
+        preempt=dict(attempts=attempts,
+                     requeues=low.get("requeues", 0), parity=True),
+        burst=dict(submitted=bound + 6, accepted=len(accepted),
+                   rejected=len(rejections),
+                   retry_after_s=[min(rejections), max(rejections)],
+                   resubmit_backoff_s=round(resubmit_s, 4)),
+        expired=1, canceled=1,
+        counters=stats["scheduler"]["sched"],
+        **mixed,
+    )
+    out.write(json.dumps(report, indent=2, sort_keys=True) + "\n")
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="loadgen")
     p.add_argument("--url", default="",
@@ -356,11 +586,22 @@ def main(argv=None) -> int:
                         "dispatches; reports hit p50/p95.  In-process "
                         "servers get a temp store so the run is "
                         "self-contained")
+    p.add_argument("--overload", action="store_true",
+                   help="overload mode (ISSUE 17): warm-latency gate, "
+                        "priority preemption with bit-for-bit resume, "
+                        "an admission-bound storm (429 + Retry-After "
+                        "on every rejection, client backoff resubmit), "
+                        "deadline expiry + cancel, and - without "
+                        "--tiny - the mixed job classes on the same "
+                        "overloaded server.  In-process servers start "
+                        "with queue_bound=4")
     p.add_argument("--tiny", action="store_true",
                    help="tier-1 smoke: in-process server, 4 plain + 4 "
                         "sweep jobs, pool-reuse + zero-compile "
                         "assertions (with --cache: 4 identical "
-                        "submits through the artifact cache)")
+                        "submits through the artifact cache; with "
+                        "--overload: the storm matrix minus the mixed "
+                        "classes)")
     args = p.parse_args(argv)
     if args.tiny:
         args.jobs, args.sweep_jobs, args.url = 4, 4, ""
@@ -370,7 +611,7 @@ def main(argv=None) -> int:
     token = None
     try:
         if not url:
-            if args.cache:
+            if args.cache or args.overload:
                 # self-contained store: the assertions need a cache
                 # that starts empty and nothing else writes to
                 import tempfile
@@ -382,8 +623,29 @@ def main(argv=None) -> int:
                 )
             from jaxtlc.serve.server import start_server
 
-            srv = start_server(sweep_width=4)
+            srv = start_server(
+                sweep_width=4,
+                **(dict(queue_bound=4) if args.overload else {}),
+            )
             url = srv.url
+        if args.overload:
+            report = run_overload(url, args.jobs,
+                                  in_process=srv is not None,
+                                  tiny=args.tiny)
+            ok = (report["warm_fresh_xla_compiles"] == 0
+                  and report["burst"]["rejected"] >= 1
+                  and report["preempt"]["requeues"] >= 1)
+            print(f"loadgen {'OK' if ok else 'FAILED'}: overload - "
+                  f"{report['burst']['accepted']} accepted + "
+                  f"{report['burst']['rejected']} rejected (429 + "
+                  f"Retry-After) of {report['burst']['submitted']} "
+                  f"burst submits, preempted heavy job resumed "
+                  f"bit-for-bit after {report['preempt']['requeues']} "
+                  f"requeue(s), 1 expired + 1 canceled, warm p50 "
+                  f"{report['warm_p50_s'] * 1000:.1f} ms / p95 "
+                  f"{report['warm_p95_s'] * 1000:.1f} ms, 0 fresh "
+                  f"compiles on the warm path")
+            return 0 if ok else 1
         if args.sim:
             report = run_sim_load(url, args.jobs,
                                   in_process=srv is not None)

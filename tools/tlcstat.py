@@ -174,6 +174,24 @@ def render(events) -> str:
             f"{misses} miss(es)  "
             f"last {cache_evs[-1]['tier']}/{cache_evs[-1]['outcome']}"
         )
+    # scheduler control plane (serve.scheduler): the service's
+    # admission/preempt/breaker decision counts, plus the queue depth
+    # of the latest event that carried one
+    sched_evs = [e for e in events if e["event"] == "sched"]
+    if sched_evs:
+        acts = {}
+        for e in sched_evs:
+            acts[e["action"]] = acts.get(e["action"], 0) + 1
+        depth = next((e["queued"] for e in reversed(sched_evs)
+                      if "queued" in e), None)
+        lines.append(
+            "sched: " + "  ".join(
+                f"{k} {acts[k]}" for k in
+                ("admit", "dispatch", "reject", "expire", "preempt",
+                 "requeue", "retry", "quarantine", "cancel")
+                if k in acts
+            ) + (f"  |  queue {depth}" if depth is not None else "")
+        )
     # phase attribution (obs.phases): cumulative measured walls per
     # phase - expand/commit from -phase-timing, device/readback free
     # at every fence
