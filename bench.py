@@ -67,6 +67,15 @@ Usage:
                                # predicate_evals_per_s with
                                # vs_baseline = device rate over the
                                # host ev.eval oracle rate
+    python bench.py --reduce-ab  # TwoPhase Model_sym (3-element
+                               # symmetric RM set) full vs symmetry-
+                               # reduced, AOT compiles shared, timed
+                               # runs interleaved best-of-5:
+                               # distinct_reduction_x metric line
+                               # with states_per_s_delta_pct,
+                               # identical-verdict gated and orbit-
+                               # certificate gated (the ISSUE 18
+                               # soundness contract)
     python bench.py --sim      # simulation tier (ISSUE 14): Model_1
                                # random walks vs the chunk-matched BFS
                                # engine, both AOT once, interleaved
@@ -975,6 +984,118 @@ def bench_expand_ab(probe_err: str) -> int:
     return 0
 
 
+def bench_reduce_ab(probe_err: str) -> int:
+    """--reduce-ab: A/B the device-resident symmetry reduction against
+    the full state space (the ISSUE 18 acceptance harness).
+
+    Runs the bundled TwoPhase Model_sym (RM = {r1, r2, r3}, a
+    3-element SYMMETRY-eligible set - 6 orbit permutations) through
+    BOTH struct engines - the full space and the orbit-canonicalizing
+    reduced one - AOT-compiled once each, timed runs INTERLEAVED
+    best-of-5 (round-8 methodology).  Gate: identical verdict AND
+    identical depth on both sides, a >= 2x distinct reduction (the
+    acceptance floor), and the reduced run's sticky orbit certificate
+    clean - a tripped COL_SYM means the canonicalization lied and the
+    harness reports failure instead of a number.  Emits a
+    `distinct_reduction_x` line carrying both distinct counts, both
+    best walls and `states_per_s_delta_pct` (generated-states
+    throughput delta; the reduced engine pays the canon kernel per
+    candidate and earns it back in states it never expands).  CPU
+    walls stand in for the chip per the standing tunnel caveat."""
+    device_note = ""
+    if probe_err:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        device_note = f" [FALLBACK cpu; tpu unreachable: {probe_err}]"
+    import jax
+
+    from jaxtlc.engine.bfs import make_backend_engine, result_from_carry
+    from jaxtlc.struct.cache import get_backend
+    from jaxtlc.struct.loader import load
+
+    workload = "TwoPhase_sym"
+    model = load("specs/TwoPhase.toolbox/Model_sym/MC.cfg")
+    kw = dict(chunk=256, queue_capacity=1 << 12, fp_capacity=1 << 14)
+    compiled = {}
+    orbit_factor = 1
+    for sym in (False, True):
+        # TwoPhase terminates: deadlock-as-violation off on both sides
+        b = get_backend(model, False, symmetry=sym)
+        if sym:
+            orbit_factor = int(b.reduce.orbit_factor)
+        init_fn, run_fn, _ = make_backend_engine(
+            b, **kw, donate=False, obs_slots=8,
+        )
+        carry0 = init_fn()
+        compiled[sym] = (run_fn.lower(carry0).compile(), carry0)
+
+    walls = {False: [], True: []}
+    finals = {}
+    for _ in range(5):
+        for sym in (False, True):
+            fn, carry0 = compiled[sym]
+            t0 = time.time()
+            out = jax.block_until_ready(fn(carry0))
+            walls[sym].append(time.time() - t0)
+            finals[sym] = out
+
+    results = {
+        sym: result_from_carry(out, min(walls[sym]),
+                               fp_capacity=kw["fp_capacity"])
+        for sym, out in finals.items()
+    }
+    full, red = results[False], results[True]
+    # soundness gates: same verdict + depth, certificate clean, and
+    # the acceptance floor on the reduction itself
+    if (red.violation, red.depth) != (full.violation, full.depth):
+        _emit({"error": "reduced verdict/depth diverged: "
+                        f"{(red.violation, red.depth)} != "
+                        f"{(full.violation, full.depth)}",
+               "workload": workload, "symmetry": True})
+        return 1
+    if getattr(red, "sym_violated", False):
+        _emit({"error": "orbit certificate tripped: the symmetry "
+                        "canonicalization is not constant on a "
+                        "reachable orbit", "workload": workload,
+               "symmetry": True})
+        return 1
+    if red.distinct * 2 > full.distinct:
+        _emit({"error": f"reduction below the 2x floor: "
+                        f"{full.distinct} -> {red.distinct}",
+               "workload": workload, "symmetry": True})
+        return 1
+
+    wall_full, wall_red = min(walls[False]), min(walls[True])
+    rate_full = full.generated / wall_full
+    rate_red = red.generated / wall_red
+    device = str(jax.devices()[0]) + device_note
+    _emit(
+        {
+            "metric": "distinct_reduction_x",
+            "value": round(full.distinct / red.distinct, 3),
+            "unit": "x",
+            "workload": workload,
+            "distinct_full": full.distinct,
+            "distinct_reduced": red.distinct,
+            "generated_full": full.generated,
+            "generated_reduced": red.generated,
+            "depth": red.depth,
+            "orbit_factor": orbit_factor,
+            "wall_s_full": round(wall_full, 3),
+            "wall_s_reduced": round(wall_red, 3),
+            "states_per_s_delta_pct": round(
+                100.0 * (rate_red - rate_full) / rate_full, 3
+            ),
+            "repeats": 5,
+            "symmetry": True,
+            "por": False,
+            "device": device,
+        }
+    )
+    return 0
+
+
 def bench_cov_ab(probe_err: str) -> int:
     """--cov-ab: measure the cost of the device coverage plane.
 
@@ -1283,6 +1404,8 @@ def main() -> int:
         return bench_commit_ab(probe_err)
     if "--expand-ab" in sys.argv:
         return bench_expand_ab(probe_err)
+    if "--reduce-ab" in sys.argv:
+        return bench_reduce_ab(probe_err)
     if "--cov-ab" in sys.argv:
         return bench_cov_ab(probe_err)
     if "--obs-ab" in sys.argv:

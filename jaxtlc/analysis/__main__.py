@@ -4,6 +4,7 @@
                               [--sweep NAME=LO..HI]...
     python -m jaxtlc.analysis --self-check [--tiny]
     python -m jaxtlc.analysis --gate [SPECS_DIR]
+    python -m jaxtlc.analysis --por-report path/to/MC.cfg
 
 The first form runs the preflight suite on a model (the same pass the
 CLI runs before a check) and prints the full report - ``--deep`` adds
@@ -65,6 +66,13 @@ def main(argv=None) -> int:
                    help="engine-free lint gate: speclint + absint over "
                         "every MC.cfg under the given directory "
                         "(default specs/); nonzero on error findings")
+    p.add_argument("--por-report", action="store_true",
+                   dest="por_report",
+                   help="engine-free state-space reduction report for "
+                        "an MC.cfg: detected symmetric constant sets "
+                        "(with rejection reasons), the action "
+                        "independence graph, and per-action POR ample "
+                        "eligibility - what -symmetry/-por would use")
     p.add_argument("--tiny", action="store_true",
                    help="tiny geometries (the tier-1 smoke mode)")
     args = p.parse_args(argv)
@@ -104,6 +112,17 @@ def main(argv=None) -> int:
     except (ValueError, OSError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return 2
+
+    if args.por_report:
+        # engine-free: pure IR analysis (speclint + symfind), no jax
+        if not isinstance(spec, StructRunSpec):
+            print("error: --por-report needs a struct-frontend spec",
+                  file=sys.stderr)
+            return 2
+        from .symfind import render_por_report
+
+        print(render_por_report(spec.structmodel))
+        return 0
     from .preflight import preflight_gen, preflight_kubeapi, preflight_struct
     from .report import print_report
 

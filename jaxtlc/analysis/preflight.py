@@ -21,6 +21,7 @@ def preflight_struct(model, *, fp_capacity: int, chunk: int,
                      queue_capacity: int, check_deadlock: bool = True,
                      deep: bool = False,
                      backend=None, bounds=None, narrow: bool = False,
+                     symmetry: bool = False,
                      const_hints=None,
                      extra_init_systems=()) -> AnalysisReport:
     """Struct-path preflight: spec lints + engine-layer arithmetic;
@@ -28,9 +29,10 @@ def preflight_struct(model, *, fp_capacity: int, chunk: int,
     (absint.BoundReport - or True to compute one here) adds the
     certified-bound report section and its findings; `narrow` marks
     that the run intends to use the narrowed codec, which escalates an
-    uncertified report to a visible warning.  `const_hints` /
-    `extra_init_systems` widen the analysis over a sweep constants
-    CLASS (jaxtlc.analysis --sweep)."""
+    uncertified report to a visible warning; `symmetry` marks that the
+    run already reduces by symmetry, which silences the unreduced-
+    symmetry nudge.  `const_hints` / `extra_init_systems` widen the
+    analysis over a sweep constants CLASS (jaxtlc.analysis --sweep)."""
     from .speclint import analyze_spec
 
     t0 = time.time()
@@ -40,6 +42,12 @@ def preflight_struct(model, *, fp_capacity: int, chunk: int,
                         const_hints=const_hints)
     report.spec = spec
     report.extend(spec.findings)
+    if not symmetry:
+        # the spec qualifies for orbit dedup but the run is not taking
+        # it: one warning per SYMMETRY-eligible constant set (ISSUE 18)
+        from .symfind import unreduced_symmetry_findings
+
+        report.extend(unreduced_symmetry_findings(model))
     if bounds is True or (bounds is None and (const_hints
                                               or extra_init_systems)):
         from .absint import analyze_bounds

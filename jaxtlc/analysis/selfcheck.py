@@ -256,6 +256,93 @@ def _build_infer():
                 fp_capacity=_TINY["fp_capacity"])
 
 
+def _build_symmetry():
+    # the symmetry-reduced engine (engine.reduce, ISSUE 18): the
+    # TwoPhase model with a 3-element symmetric RM set, compiled with
+    # the on-device orbit canonicalization + the sticky COL_SYM orbit
+    # certificate - the permutation-program tournament and the ring's
+    # tenth column cannot ship unaudited
+    import os
+
+    from ..engine.bfs import make_backend_engine
+    from ..struct.cache import get_backend
+    from ..struct.loader import load
+
+    d = _specs_dir()
+    if d is None:
+        raise FileNotFoundError("specs/ directory not found")
+    model = load(os.path.join(d, "TwoPhase.toolbox", "Model_sym",
+                              "MC.cfg"))
+    b = get_backend(model, False, symmetry=True)
+    assert b.reduce is not None and b.reduce.plan is not None, \
+        "symmetry factory must carry an orbit plan"
+    init_fn, run_fn, step_fn = make_backend_engine(
+        b, donate=False, obs_slots=8, **_TINY
+    )
+    return dict(init_fn=init_fn, run_fn=run_fn, step_fn=step_fn,
+                n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
+
+
+_POR_SPEC = """---- MODULE PorAudit ----
+EXTENDS Naturals
+VARIABLES x, y
+
+Init == x = 0 /\\ y = 0
+
+IncX == /\\ x < 4
+        /\\ x' = x + 1
+        /\\ UNCHANGED <<y>>
+
+IncY == /\\ y < 4
+        /\\ y' = y + 1
+        /\\ UNCHANGED <<x>>
+
+Next == IncX \\/ IncY
+
+Spec == Init /\\ [][Next]_<<x, y>>
+
+InRange == x <= 4
+====
+"""
+
+_POR_CFG = """SPECIFICATION
+Spec
+INVARIANT
+InRange
+"""
+
+
+def _build_por():
+    # the partial-order-pruned engine (engine.reduce, ISSUE 18):
+    # audited over a synthetic two-counter module whose IncY is a POR-
+    # safe action (independent, invisible to the invariant, monotone;
+    # frame conjuncts MUST be UNCHANGED or speclint counts them as
+    # writes) - the singleton-ample lane-mask path cannot ship
+    # unaudited
+    import os
+    import tempfile
+
+    from ..engine.bfs import make_backend_engine
+    from ..struct.cache import get_backend
+    from ..struct.loader import load
+
+    d = tempfile.mkdtemp(prefix="jaxtlc-por-audit-")
+    with open(os.path.join(d, "PorAudit.tla"), "w") as f:
+        f.write(_POR_SPEC)
+    cfg = os.path.join(d, "PorAudit.cfg")
+    with open(cfg, "w") as f:
+        f.write(_POR_CFG)
+    model = load(cfg)
+    b = get_backend(model, False, por=True)
+    assert b.reduce is not None and b.reduce.safe_ids, \
+        "por factory must carry safe action ids"
+    init_fn, run_fn, step_fn = make_backend_engine(
+        b, donate=False, obs_slots=8, **_TINY
+    )
+    return dict(init_fn=init_fn, run_fn=run_fn, step_fn=step_fn,
+                n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
+
+
 def _build_enumerator():
     from ..engine.bfs import make_enumerator
 
@@ -371,12 +458,14 @@ FACTORIES: Dict[str, Callable[[], dict]] = {
     "narrowed": _build_narrowed,
     "phased": _build_phased,
     "pipelined": _build_pipelined,
+    "por": _build_por,
     "sharded": _build_sharded,
     "sim": _build_sim,
     "sortfree": _build_sortfree,
     "spill": _build_spill,
     "struct": _build_struct,
     "sweep": _build_sweep,
+    "symmetry": _build_symmetry,
     "enumerator": _build_enumerator,
 }
 

@@ -60,6 +60,16 @@ class CheckRequest:
     # The -simulate tier ignores it: every walker state is "fresh", so
     # the sim engines keep their immediate per-walker invariant path.
     deferredinv: Optional[bool] = None
+    # tri-state -symmetry/-no-symmetry and -por/-no-por (ISSUE 18):
+    # None = auto (resolve_symmetry/resolve_por - currently OFF: both
+    # reductions legitimately shrink the state counts, so they are
+    # opt-in, not auto-on perf modes).  -symmetry canonicalizes every
+    # successor to its orbit representative over statically-verified
+    # symmetric constant sets (runtime orbit certificate on single
+    # device); -por prunes commutative interleavings of provably safe
+    # actions.  Struct frontend only.
+    symmetry: Optional[bool] = None
+    por: Optional[bool] = None
     routefactor: float = 2.0
     qcap: int = 1 << 15
     fpcap: int = 1 << 20
@@ -649,6 +659,21 @@ def _deferred(args) -> bool:
                             args.chunk)
 
 
+def _symmetry(args) -> bool:
+    """The RESOLVED -symmetry mode this run's engines will use (journal
+    manifests record the fact, not the tri-state)."""
+    from .engine.bfs import resolve_symmetry
+
+    return resolve_symmetry(getattr(args, "symmetry", None), args.chunk)
+
+
+def _por(args) -> bool:
+    """The RESOLVED -por mode this run's engines will use."""
+    from .engine.bfs import resolve_por
+
+    return resolve_por(getattr(args, "por", None), args.chunk)
+
+
 def _open_journal(args, workload: str, engine: str, device: str,
                   params: dict):
     """Create the run journal and stamp the manifest.
@@ -757,6 +782,12 @@ def _resume_command(args) -> str:
         # same contract as -sort-free: auto re-resolves from the chunk
         parts += ["-deferred-inv" if args.deferredinv
                   else "-no-deferred-inv"]
+    if getattr(args, "symmetry", None) is not None:
+        # same contract: a reduced frontier is a different exploration,
+        # the resume must repeat the mode or the meta check rejects it
+        parts += ["-symmetry" if args.symmetry else "-no-symmetry"]
+    if getattr(args, "por", None) is not None:
+        parts += ["-por" if args.por else "-no-por"]
     if getattr(args, "narrow", False):
         parts += ["-narrow"]  # the narrowed codec is a different layout
     if getattr(args, "coverage", False):
@@ -1002,6 +1033,7 @@ def _run_check_struct(args, spec) -> int:
         log = log_holder[0]
         ckd = spec.check_deadlock
         cov = args.coverage
+        sym, por = _symmetry(args), _por(args)
         kw = dict(chunk=args.chunk, queue_capacity=args.qcap,
                   fp_capacity=args.fpcap)
         if args.sharded:
@@ -1016,7 +1048,8 @@ def _run_check_struct(args, spec) -> int:
                 sup = check_sharded_supervised(
                     None, mesh,
                     backend=get_backend(sm, ckd, bounds=bounds,
-                                        elide=False, coverage=cov),
+                                        elide=False, coverage=cov,
+                                        symmetry=sym, por=por),
                     meta_config=struct_meta_config(sm, bounds=bounds),
                     route_factor=args.routefactor,
                     pipeline=args.pipeline,
@@ -1031,7 +1064,8 @@ def _run_check_struct(args, spec) -> int:
                 check_deadlock=ckd, pipeline=args.pipeline,
                 obs_slots=_obs_slots(args), bounds=bounds,
                 coverage=cov, sort_free=args.sortfree,
-                deferred=args.deferredinv, **kw,
+                deferred=args.deferredinv, symmetry=args.symmetry,
+                por=args.por, **kw,
             ), None
         if args.checkpoint or args.autogrow:
             from .resil import check_supervised
@@ -1039,7 +1073,8 @@ def _run_check_struct(args, spec) -> int:
             sup = check_supervised(
                 None, fp_index=spec.fp_index,
                 backend=get_backend(sm, ckd, bounds=bounds,
-                                    coverage=cov),
+                                    coverage=cov, symmetry=sym,
+                                    por=por),
                 meta_config=struct_meta_config(sm, bounds=bounds),
                 check_deadlock=ckd,
                 pipeline=args.pipeline,
@@ -1053,7 +1088,8 @@ def _run_check_struct(args, spec) -> int:
             sm, fp_index=spec.fp_index, check_deadlock=ckd,
             pipeline=args.pipeline, obs_slots=_obs_slots(args),
             bounds=bounds, coverage=cov, sort_free=args.sortfree,
-            deferred=args.deferredinv, capture_fps=capture, **kw,
+            deferred=args.deferredinv, symmetry=args.symmetry,
+            por=args.por, capture_fps=capture, **kw,
         ), None
 
     def props():
@@ -1096,6 +1132,28 @@ def _run_check_struct(args, spec) -> int:
         # never fired is the dynamic counterpart of the PR 6 lint
         return _struct_dead_sites(args, spec, sm, bounds, r)
 
+    def reduce_info():
+        # the journal `reduce` event's static half (ISSUE 18): what
+        # the reduction machinery resolved for this run (the backend
+        # memo makes this a cache hit, not a recompile)
+        sym, por = _symmetry(args), _por(args)
+        if not (sym or por):
+            return None
+        red = get_backend(
+            sm, spec.check_deadlock, bounds=bounds,
+            elide=not args.sharded, coverage=args.coverage,
+            symmetry=sym, por=por,
+        ).reduce
+        if red is None:
+            return None
+        return dict(
+            symmetry=sym, por=por,
+            orbit_factor=red.orbit_factor,
+            symmetric_sets={k: list(v) for k, v in red.sym_sets},
+            dropped_sets=dict(red.dropped_sets),
+            safe_actions=len(red.safe_ids),
+        )
+
     kit = _InterpKit(
         kind="structural",
         # the structural liveness graph is wf_next-only so far
@@ -1121,6 +1179,7 @@ def _run_check_struct(args, spec) -> int:
         coverage_device=coverage_device,
         dead_site_lint=dead_site_lint,
         artifact_plan=art_plan,
+        reduce_info=reduce_info,
     )
     return _run_check_interp(args, spec, kit, log_holder=log_holder)
 
@@ -1153,6 +1212,8 @@ def _run_sim_struct(args, spec) -> int:
             ("-narrow", args.narrow),
             ("-phase-timing", args.phasetiming),
             ("-mutation", args.mutation),
+            ("-symmetry", getattr(args, "symmetry", None)),
+            ("-por", getattr(args, "por", None)),
             ("-fpset DiskFPSet", args.fpset != "JaxFPSet"),
         ) if on
     ]
@@ -1391,6 +1452,8 @@ def _run_infer_struct(args, spec) -> int:
             ("-checkpoint", args.checkpoint),
             ("-recover", args.recover),
             ("-faults", args.faults),
+            ("-symmetry", getattr(args, "symmetry", None)),
+            ("-por", getattr(args, "por", None)),
             ("-fpset DiskFPSet", args.fpset != "JaxFPSet"),
         ) if on
     ]
@@ -1537,6 +1600,13 @@ def _artifact_plan(args, spec, sm, bounds):
         # inference verdict is about CANDIDATES - neither may publish
         # to the verdict tier
         return None
+    if _symmetry(args) or _por(args):
+        # a reduced run's fp table is the REDUCED reachable set: its
+        # verdict is sound but its reachable-set tier would silently
+        # under-cover an invariant-only re-check whose NEW invariant
+        # the symmetry verifier never saw - reduced runs neither read
+        # nor publish artifacts
+        return None
     from .struct import artifacts as _arts
 
     store = _arts.store_for(args)
@@ -1624,7 +1694,7 @@ def _struct_preflight(args, spec, sm, deep):
         sm, fp_capacity=args.fpcap, chunk=args.chunk,
         queue_capacity=args.qcap, check_deadlock=spec.check_deadlock,
         deep=deep, backend=backend, bounds=bounds,
-        narrow=args.narrow,
+        narrow=args.narrow, symmetry=_symmetry(args),
     )
 
 
@@ -1637,7 +1707,7 @@ class _InterpKit:
                  state_to_tla, state_env, violation_trace,
                  coverage=None, action_order=None, preflight=None,
                  coverage_device=None, dead_site_lint=None,
-                 artifact_plan=None):
+                 artifact_plan=None, reduce_info=None):
         self.kind = kind
         self.extra_unsupported = extra_unsupported
         self.check = check  # () -> (CheckResult, SupervisedResult | None)
@@ -1659,6 +1729,9 @@ class _InterpKit:
         # re-checking seam (verdict/reach lookup before any engine
         # build, clean-verdict artifact write after)
         self.artifact_plan = artifact_plan
+        # () -> dict | None: state-space reduction facts for the
+        # journal `reduce` event (struct frontend, ISSUE 18)
+        self.reduce_info = reduce_info
 
 
 def _run_check_interp(args, spec, kit: "_InterpKit",
@@ -1701,6 +1774,7 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
                     pipeline=args.pipeline, frontend=kit.kind,
                     sort_free=_sort_free(args),
                     deferred=_deferred(args),
+                    symmetry=_symmetry(args), por=_por(args),
                     obs_slots=_obs_slots(args)),
     )
     # incremental re-checking (ISSUE 13): try the artifact tiers BEFORE
@@ -1754,6 +1828,49 @@ def _run_check_interp(args, spec, kit: "_InterpKit",
         log.final_counts(r.generated, r.distinct, r.queue_left)
         _finish_journal(args, log, r=None, sup=sup)
         return EXIT_INTERRUPTED
+    red_info = kit.reduce_info() if kit.reduce_info is not None else None
+    if red_info is not None:
+        # the `reduce` journal event (schema v1, ISSUE 18): how much
+        # the reduction actually bought this run.  ample_hit_rate is
+        # pruned/(generated+pruned) - the share of candidate
+        # transitions the singleton ample sets cut before dedup
+        pruned = int(getattr(r, "por_pruned", None) or 0)
+        total = int(r.generated) + pruned
+        j = getattr(args, "_journal", None)
+        if j is not None:
+            j.event(
+                "reduce",
+                states_pruned=pruned,
+                ample_hit_rate=(round(pruned / total, 6) if total
+                                else 0.0),
+                generated=int(r.generated),
+                distinct=int(r.distinct),
+                **red_info,
+            )
+    if getattr(r, "sym_violated", False):
+        # the runtime orbit certificate tripped: the canonicalization
+        # was NOT constant on some reachable orbit, so the symmetry
+        # reduction may have merged states it had no right to merge -
+        # every count (and the clean verdict) is untrustworthy.  Loud
+        # error verdict, same discipline as the bound certificate
+        detail = ("runtime orbit-certificate violation: the symmetry "
+                  "canonicalization is not orbit-invariant on a "
+                  "reachable state; re-run with -no-symmetry and "
+                  "report the spec (the symmetry verification is "
+                  "unsound)")
+        j = getattr(args, "_journal", None)
+        if j is not None:
+            j.event("analysis", layer="spec", check="orbit-certificate",
+                    severity="error", subject=spec.spec_name,
+                    detail=detail)
+            j.event("final", verdict="error", generated=r.generated,
+                    distinct=r.distinct, depth=r.depth,
+                    queue=r.queue_left,
+                    wall_s=round(time.time() - t0, 6),
+                    interrupted=False)
+        log.msg(1000, f"ERROR: {detail}", severity=1)
+        _finish_journal(args, log)
+        return 1
     if getattr(r, "cert_violated", False):
         # the runtime certificate tripped: a reachable state violated a
         # bound the certified abstract interpretation claimed, so every

@@ -138,6 +138,49 @@ def main(argv=None) -> int:
                    action="store_const", const=False,
                    help="force immediate per-candidate invariant/cert "
                         "evaluation at any chunk")
+    c.add_argument("-symmetry", dest="symmetry", action="store_const",
+                   const=True, default=None,
+                   help="device-resident symmetry reduction (ISSUE "
+                        "18): statically verify which CONSTANT sets "
+                        "the spec treats as fully permutation-"
+                        "symmetric (the TLC SYMMETRY condition, "
+                        "checked against the spec text - no "
+                        "annotation needed), then canonicalize every "
+                        "successor to its orbit representative on "
+                        "device before fingerprinting, so the fpset "
+                        "dedups orbits.  Same verdict, same rendered "
+                        "trace, legitimately fewer DISTINCT/"
+                        "GENERATED states (up to the product of "
+                        "|S|! over the reduced sets).  A runtime "
+                        "orbit certificate re-checks canonicalization "
+                        "on every iteration (single device): a trip "
+                        "is a loud error verdict, never a silently "
+                        "wrong count.  Struct frontend only; "
+                        "inherited by every engine at the expand/"
+                        "commit seam.  Default off (counts shrink - "
+                        "this is not a transparent perf mode).  A "
+                        "checkpoint records the mode: -recover must "
+                        "match")
+    c.add_argument("-no-symmetry", dest="symmetry", action="store_const",
+                   const=False,
+                   help="force the unreduced full state space")
+    c.add_argument("-por", dest="por", action="store_const",
+                   const=True, default=None,
+                   help="partial-order pruning (ISSUE 18): where a "
+                        "provably safe action is enabled (independent "
+                        "of every other action, invisible to every "
+                        "invariant, and a monotone counter - so no "
+                        "all-ample cycle can starve the rest), expand "
+                        "only that action's transitions instead of "
+                        "every commutative interleaving.  Same "
+                        "verdict, legitimately fewer states; the "
+                        "journal `reduce` event reports transitions "
+                        "pruned.  Struct frontend only; default off.  "
+                        "A checkpoint records the mode: -recover "
+                        "must match")
+    c.add_argument("-no-por", dest="por", action="store_const",
+                   const=False,
+                   help="force full interleaving expansion")
     c.add_argument("-routefactor", type=float, default=2.0,
                    help="sharded all_to_all bucket size as a multiple of "
                         "the mean per-owner candidate count (raise after "

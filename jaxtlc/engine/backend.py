@@ -84,6 +84,11 @@ class SpecBackend(NamedTuple):
     # Pure telemetry - feeds no control flow, so coverage-on results
     # are bit-for-bit coverage-off results
     coverage: object = None
+    # optional state-space reduction (engine.reduce.ReduceOps, ISSUE
+    # 18): symmetry canonicalization + POR ample-set pruning applied
+    # inside the expand stage - every make_stage_pair consumer inherits
+    # both.  None keeps pre-reduction pytree layouts exactly
+    reduce: object = None
 
 
 class ExpandOut(NamedTuple):
@@ -118,6 +123,13 @@ class ExpandOut(NamedTuple):
     # None in immediate mode, so pre-deferred carries/stages keep their
     # exact pytree layout.
     flat: jnp.ndarray = None
+    # bool scalar: the orbit-certification sample of this block failed
+    # to re-canonicalize (engine.reduce.ReducePlan.orbit_check; None
+    # when symmetry reduction is off, keeping pytree layouts exact)
+    sym: jnp.ndarray = None
+    # uint32 scalar: candidate transitions pruned by the POR ample-set
+    # mask in this block (None when POR is off)
+    pruned: jnp.ndarray = None
 
 
 def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
@@ -167,6 +179,18 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
     gen_counts_fn = backend.gen_counts
     if check_deadlock is None:
         check_deadlock = backend.check_deadlock
+    red = backend.reduce
+    sym_plan = red.plan if red is not None else None
+    por_on = bool(
+        red is not None and red.por and red.safe_ids
+        and lane_action is not None
+    )
+    if por_on:
+        from .reduce import por_keep
+
+        safe_vec = jnp.asarray(np.array(
+            [a in red.safe_ids for a in range(n_labels)], bool
+        ))
 
     def expand(batch, mask):
         succs, valid, action, afail, ovf = jax.vmap(step)(batch)
@@ -178,9 +202,27 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
             else jnp.zeros(chunk, bool)
         )
 
+        # POR ample-set pruning: AFTER the deadlock test (pruning must
+        # never fabricate a deadlock) and after afail/ovf masking (a
+        # trapped or asserting transition still halts when postponed)
+        pruned = None
+        if por_on:
+            keep = por_keep(valid, lane_action, safe_vec, n_labels)
+            pruned = (valid & ~keep).sum().astype(jnp.uint32)
+            valid = keep
+
         flat = succs.reshape(ncand, F)
         fvalid = valid.reshape(-1)
         faction = action.reshape(-1)
+
+        # symmetry reduction: replace every successor by its orbit
+        # representative BEFORE invariants/pack/fingerprints, so the
+        # fpset dedups orbits and everything downstream (including the
+        # deferred commit-side checker reading ExpandOut.flat) sees
+        # canonical states - sound because symfind verified the spec
+        # cannot distinguish orbit members
+        if sym_plan is not None:
+            flat = sym_plan.canon(flat)
 
         # deferred mode: invariants + certificate run at the commit
         # stage on the fresh-insert claimants only (the distinct-first
@@ -215,6 +257,15 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
             cov = backend.coverage.count(batch, mask, valid).astype(
                 jnp.uint32
             )
+
+        # runtime orbit certification (COL_SYM): one sampled canonical
+        # row per body, re-canonicalized through a content-selected
+        # permutation - a mismatch means the symmetry plan is not
+        # acting as a permutation group and the run's dedup cannot be
+        # trusted; the engine latches it into an error verdict
+        sym = None
+        if sym_plan is not None:
+            sym = sym_plan.orbit_check(flat, fvalid)
 
         # per-action generated counters, scatter-free: the backend's
         # factorized hook (KubeAPI dispatch structure, PERF.md item 5)
@@ -265,6 +316,7 @@ def make_expand_stage(backend: SpecBackend, chunk: int, check_deadlock,
             gen=gen, viol=viol, viol_state=viol_state,
             viol_action=viol_action, cert=cert, cov=cov,
             flat=flat if deferred else None,
+            sym=sym, pruned=pruned,
         )
 
     return expand

@@ -152,6 +152,20 @@ class EngineCarry(NamedTuple):
     # telemetry, exactly like the obs ring above.
     cov_counts: jnp.ndarray = None  # [n_sites] uint32
     st_cov: jnp.ndarray = None  # staged block's increments (pipelined)
+    # --- state-space reduction (None without backend.reduce, ISSUE 18)
+    # Sticky bool: the orbit-certification sample of some block failed
+    # to re-canonicalize (engine.reduce.ReducePlan.orbit_check) - the
+    # symmetry plan is not acting as a permutation group, so the orbit
+    # dedup cannot be trusted.  Latched every body, mirrored into the
+    # obs ring's COL_SYM, escalated to an error verdict by the check
+    # drivers - the COL_CERT pattern exactly.
+    sym_viol: jnp.ndarray = None  # bool
+    st_sym: jnp.ndarray = None  # staged block's orbit-check bit
+    # Cumulative uint32: candidate transitions the POR ample-set mask
+    # pruned at expand time (journalled as the `reduce` event's counter
+    # delta; state counts legitimately shrink under POR)
+    por_pruned: jnp.ndarray = None  # uint32
+    st_pruned: jnp.ndarray = None  # staged block's pruned count
 
 
 class CheckResult(NamedTuple):
@@ -192,6 +206,14 @@ class CheckResult(NamedTuple):
     # (struct.artifacts.states_from_table); None everywhere else so
     # results stay light
     fp_table: object = None
+    # orbit-certification verdict of a symmetry-reduced run: None = no
+    # orbit check carried; False = every sampled canonical row
+    # re-canonicalized consistently; True = the symmetry plan LIED -
+    # the check drivers escalate this to an error verdict (exit 1)
+    sym_violated: bool = None
+    # candidate transitions pruned by POR ample sets (None when POR is
+    # off) - the journalled counter delta of the `reduce` event
+    por_pruned: int = None
 
 
 def carry_done(carry: EngineCarry) -> bool:
@@ -249,6 +271,28 @@ def resolve_deferred(deferred, chunk: int) -> bool:
     if deferred is not None:
         return bool(deferred)
     return chunk >= DEFERRED_AUTO_CHUNK
+
+
+def resolve_symmetry(symmetry, chunk: int = 0) -> bool:
+    """Resolve the tri-state -symmetry flag (None = auto).  Auto is
+    OFF: orbit dedup legitimately SHRINKS the distinct-state count, so
+    unlike sort-free/deferred it is not a pure performance mode and
+    must be opted into.  Same resolver shape as resolve_sort_free so
+    engine memos, checkpoint meta, resume commands and journal params
+    all agree without coordination (`chunk` is accepted for signature
+    symmetry; the answer does not depend on it)."""
+    if symmetry is not None:
+        return bool(symmetry)
+    return False
+
+
+def resolve_por(por, chunk: int = 0) -> bool:
+    """Resolve the tri-state -por flag (None = auto).  Auto is OFF for
+    the same reason as resolve_symmetry: ample-set pruning changes the
+    explored-state counts (verdicts are preserved, counts are not)."""
+    if por is not None:
+        return bool(por)
+    return False
 
 
 def make_engine(
@@ -564,6 +608,15 @@ def make_stage_pair(
             # the staged expand bit - same column, same stickiness)
             cert_now = c.cert_viol | cert_src
             extra["cert_viol"] = cert_now
+        sym_now = None
+        if ex.sym is not None and c.sym_viol is not None:
+            # orbit certification (ISSUE 18): same sticky latch as the
+            # certificate bit - computed at expand on the canonical
+            # fields, so the deferred mode needs no commit-site variant
+            sym_now = c.sym_viol | ex.sym
+            extra["sym_viol"] = sym_now
+        if ex.pruned is not None and c.por_pruned is not None:
+            extra["por_pruned"] = c.por_pruned + ex.pruned
         if ex.cov is not None and c.cov_counts is not None:
             # device coverage plane: fold this block's per-site visit
             # increments into the cumulative counters (telemetry only)
@@ -595,6 +648,7 @@ def make_stage_pair(
                 overflow=sticky_overflow(c.obs_ring, wrapped),
                 spill=extra.get("spill_hits"),
                 cert=cert_now,
+                sym=sym_now,
             )
             ring, head = ring_update(
                 c.obs_ring, c.obs_head, row, level_done
@@ -721,6 +775,15 @@ def make_backend_engine(
     # in deferred mode the staged ExpandOut carries the raw fields
     # (st_flat) and no cert bit (the commit-site checker derives it)
     stage_cert = has_cert and not deferred
+    # state-space reduction (ISSUE 18): presence of the plan / POR
+    # rights decides the carry leaves, mirroring make_expand_stage's
+    # own gating exactly so staged blocks and ExpandOut always agree
+    red = backend.reduce
+    has_sym = red is not None and red.plan is not None
+    has_por = bool(
+        red is not None and red.por and red.safe_ids
+        and backend.lane_action is not None
+    )
     cov_plane = backend.coverage
     n_sites = cov_plane.n_sites if cov_plane is not None else 0
     cdc = backend.cdc
@@ -755,6 +818,12 @@ def make_backend_engine(
         if inits is None:
             inits = backend.initial_vectors()
         inits = jnp.asarray(inits)
+        if has_sym:
+            # seed the frontier with orbit representatives: Init is
+            # permutation-closed (symfind verified init_ast mentions no
+            # symmetric atom), so every reachable orbit stays reachable
+            # from the canonicalized seeds
+            inits = red.plan.canon(inits)
         n0 = inits.shape[0]
         assert n0 <= chunk and n0 <= qcap, "raise chunk/queue_capacity"
         packed0 = cdc.pack(inits)
@@ -798,8 +867,16 @@ def make_backend_engine(
                 staged["st_cov"] = jnp.zeros(n_sites, jnp.uint32)
             if deferred:
                 staged["st_flat"] = jnp.zeros((ncand_full, F), jnp.int32)
+            if has_sym:
+                staged["st_sym"] = jnp.bool_(False)
+            if has_por:
+                staged["st_pruned"] = jnp.uint32(0)
         if has_cert:
             staged["cert_viol"] = jnp.bool_(False)
+        if has_sym:
+            staged["sym_viol"] = jnp.bool_(False)
+        if has_por:
+            staged["por_pruned"] = jnp.uint32(0)
         if cov_plane is not None:
             # coverage counters seeded with the Init-site visits (the
             # host-side charge for the seed states; zero when the plane
@@ -867,6 +944,10 @@ def make_backend_engine(
                 extra["st_cov"] = ex.cov
             if deferred:
                 extra["st_flat"] = ex.flat
+            if has_sym:
+                extra["st_sym"] = ex.sym
+            if has_por:
+                extra["st_pruned"] = ex.pruned
             return c._replace(
                 st_packed=ex.packed, st_lo=ex.lo, st_hi=ex.hi,
                 st_valid=ex.valid, st_action=ex.action, st_gen=ex.gen,
@@ -883,6 +964,8 @@ def make_backend_engine(
                 cert=c.st_cert if stage_cert else None,
                 cov=c.st_cov if cov_plane is not None else None,
                 flat=c.st_flat if deferred else None,
+                sym=c.st_sym if has_sym else None,
+                pruned=c.st_pruned if has_por else None,
             )
 
         # The two-deep pipeline body, bubble-free: the staged block k-1
@@ -1256,6 +1339,12 @@ def result_from_carry(
     staged_n = int(carry.st_n) if carry.st_n is not None else 0
     cert = getattr(carry, "cert_viol", None)
     cert_violated = bool(cert) if cert is not None else None
+    sym = getattr(carry, "sym_viol", None)
+    sym_violated = bool(sym) if sym is not None else None
+    pruned = getattr(carry, "por_pruned", None)
+    if pruned is not None:
+        pruned = int(np.asarray(pruned).sum())  # shards carry partials
+    por_pruned = pruned
     site_coverage = None
     totals = cov_totals(carry)
     if totals is not None and sites is not None:
@@ -1286,4 +1375,6 @@ def result_from_carry(
         fp_occupancy=occupancy,
         cert_violated=cert_violated,
         site_coverage=site_coverage,
+        sym_violated=sym_violated,
+        por_pruned=por_pruned,
     )

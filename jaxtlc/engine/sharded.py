@@ -269,6 +269,24 @@ def make_sharded_engine(
 
     sort_free = resolve_sort_free(sort_free, chunk)
     deferred = resolve_deferred(deferred, chunk)
+    # state-space reduction (ISSUE 18) rides on the backend: orbit
+    # canonicalization runs BEFORE fingerprinting so representatives
+    # route to consistent owners on every device; the mesh engine has
+    # no sticky ring columns, so (like the certificate column) the
+    # orbit check is a single-device feature - sharded runs still get
+    # the reduction itself
+    red = backend.reduce
+    sym_plan = red.plan if red is not None else None
+    por_on = bool(
+        red is not None and red.por and red.safe_ids
+        and backend.lane_action is not None
+    )
+    if por_on:
+        from .reduce import por_keep
+
+        safe_vec = jnp.asarray(np.array(
+            [a in red.safe_ids for a in range(n_labels)], bool
+        ))
     # slab compaction width of the owner-side insert: received valid
     # candidates ~2 per popped state at steady load balance, so 4x
     # chunk covers bursts; wider batches take the exact sorted fallback
@@ -293,6 +311,11 @@ def make_sharded_engine(
 
     def init_fn() -> ShardCarry:
         inits = backend.initial_vectors()  # [n0, F] numpy
+        if sym_plan is not None:
+            # orbit-canonical seeds (host twin of the device canon):
+            # Init is permutation-closed under the verified sets, so
+            # canonicalizing loses no initial orbit
+            inits = sym_plan.canon_host(inits)
         packed = cdc.pack(jnp.asarray(inits))
         lo, hi = fp64_words(packed, nbits, fp_index, seed)
         own = np.asarray(owner_of(hi))
@@ -432,10 +455,22 @@ def make_sharded_engine(
             mask & ~valid.any(axis=1) if backend.check_deadlock
             else jnp.zeros(chunk, bool)
         )
+        if por_on:
+            # singleton-ample pruning AFTER afail/ovf/dead are taken
+            # from the full valid set: a pruned trapping transition
+            # still halts, and POR never fabricates a deadlock
+            valid = por_keep(valid, backend.lane_action, safe_vec,
+                             n_labels)
 
         flat = succs.reshape(ncand, F)
         fvalid = valid.reshape(-1)
         faction = action.reshape(-1)
+        if sym_plan is not None:
+            # canonicalize before invariants/pack/fingerprint: the
+            # invariant sweep sees the orbit representative (sound -
+            # symfind verified the invariants cannot distinguish orbit
+            # members) and owners dedup representatives
+            flat = sym_plan.canon(flat)
 
         # deferred mode skips the pre-routing chunk*L invariant sweep:
         # the owner checks its fresh-insert claimants below instead
@@ -1037,6 +1072,10 @@ def check_sharded_with_checkpoints(
         pipeline=pipeline, obs_slots=obs_slots, sort_free=sort_free,
         deferred=deferred,
     )
+    # the reduction flags ride on the backend; a reduced run explores a
+    # DIFFERENT (smaller) frontier, so resuming a reduced checkpoint
+    # without the flags (or vice versa) must mismatch loudly
+    red = getattr(backend, "reduce", None)
     meta = _meta(
         cfg,
         meta_config=meta_config,
@@ -1047,6 +1086,8 @@ def check_sharded_with_checkpoints(
         obs_slots=obs_slots,
         sort_free=sort_free,
         deferred=deferred,
+        symmetry=bool(red is not None and red.plan is not None),
+        por=bool(red is not None and red.por and red.safe_ids),
     )
     template = init_fn()
     compiled = seg_fn.lower(template).compile()
@@ -1057,13 +1098,13 @@ def check_sharded_with_checkpoints(
         saved_meta, carry = load_checkpoint(ckpt_path, template)
         for key in ("format", "config", "queue_capacity", "fp_capacity",
                     "devices", "pipeline", "obs_slots", "sort_free",
-                    "deferred"):
-            # pre-pipeline/pre-obs/pre-sort-free/pre-deferred
-            # snapshots carry no key: treat as off - they were cut
-            # from engines without those features
+                    "deferred", "symmetry", "por"):
+            # pre-pipeline/pre-obs/pre-sort-free/pre-deferred/
+            # pre-reduction snapshots carry no key: treat as off -
+            # they were cut from engines without those features
             saved = saved_meta.get(
                 key, False if key in ("pipeline", "sort_free",
-                                      "deferred")
+                                      "deferred", "symmetry", "por")
                 else 0 if key == "obs_slots" else None
             )
             if saved != meta[key]:

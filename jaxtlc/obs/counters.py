@@ -40,8 +40,15 @@ accuracy):
                       error verdict, so an unsound narrowing can never
                       silently drop real states; always 0 on engines
                       without a certificate check
-    col 9..9+A-1      per-action generated (cumulative)
-    col 9+A..9+2A-1   per-action distinct  (cumulative)
+    col 9  sym        STICKY orbit-certificate flag (ISSUE 18): 1 once
+                      the runtime orbit check caught the symmetry
+                      canonicalization NOT constant on a reachable
+                      orbit - decoded as `sym_violation` and escalated
+                      to an error verdict, so an unsound symmetry
+                      reduction can never silently merge real states;
+                      always 0 on engines without symmetry reduction
+    col 10..10+A-1      per-action generated (cumulative)
+    col 10+A..10+2A-1   per-action distinct  (cumulative)
 
 The ring array is [slots + 1, cols]: row `slots` is the dump row.
 `head` counts rows ever written (the slot of row k is k % slots), so
@@ -56,9 +63,10 @@ import numpy as np
 
 DEFAULT_OBS_SLOTS = 256
 
-N_FIXED_COLS = 9
+N_FIXED_COLS = 10
 (COL_LEVEL, COL_GENERATED, COL_DISTINCT, COL_QUEUE, COL_BODIES,
- COL_EXPANDED, COL_OVERFLOW, COL_SPILL, COL_CERT) = range(N_FIXED_COLS)
+ COL_EXPANDED, COL_OVERFLOW, COL_SPILL, COL_CERT,
+ COL_SYM) = range(N_FIXED_COLS)
 COL_RES0 = COL_OVERFLOW  # pre-overflow name of col 6
 COL_RES1 = COL_SPILL  # pre-spill name of col 7
 
@@ -94,12 +102,14 @@ def ring_update(ring, head, row, flip):
 
 
 def pack_row(level, generated, distinct, queue, bodies, expanded,
-             act_gen, act_dist, overflow=None, spill=None, cert=None):
+             act_gen, act_dist, overflow=None, spill=None, cert=None,
+             sym=None):
     """Assemble one ring row from carry scalars (device-side).
     `overflow` is the sticky uint32 saturation flag (COL_OVERFLOW);
     `spill` the cumulative host-spill-hit counter (COL_SPILL); `cert`
-    the sticky certificate-violation flag (COL_CERT); None writes 0
-    (engines that predate the flag / carry no such tier)."""
+    the sticky certificate-violation flag (COL_CERT); `sym` the sticky
+    orbit-certificate flag (COL_SYM); None writes 0 (engines that
+    predate the flag / carry no such tier)."""
     import jax.numpy as jnp
 
     u = jnp.uint32
@@ -109,6 +119,7 @@ def pack_row(level, generated, distinct, queue, bodies, expanded,
         u(0) if overflow is None else overflow.astype(u),
         u(0) if spill is None else spill.astype(u),
         u(0) if cert is None else cert.astype(u),
+        u(0) if sym is None else sym.astype(u),
     ])
     return jnp.concatenate(
         [fixed, act_gen.astype(u), act_dist.astype(u)]
@@ -178,6 +189,10 @@ def rows_from_ring(
             # sticky certificate flag: a generated state violated a
             # bound the certified abstract interpretation claimed
             row["cert_violation"] = True
+        if r[COL_SYM]:
+            # sticky orbit-certificate flag: the symmetry
+            # canonicalization was caught non-constant on an orbit
+            row["sym_violation"] = True
         if labels is not None:
             a = len(labels)
             gen = r[N_FIXED_COLS:N_FIXED_COLS + a]

@@ -134,6 +134,17 @@ EVENTS = {
     # with a machine-checked inductive basis
     "infer": {"phase": _STR, "candidates": _NUM, "killed": _NUM,
               "survivors": _NUM, "certified": _NUM},
+    # -- state-space reduction (engine.reduce, ISSUE 18) -------------------
+    # one per reduced run, before the final event: what the symmetry/
+    # POR reduction bought.  states_pruned = transitions the singleton
+    # ample sets cut pre-dedup, ample_hit_rate = pruned/(generated+
+    # pruned), orbit_factor = the group order (product of |S|! over
+    # the reduced sets; 1 = symmetry off or no realisable set).  Extra
+    # fields: symmetry/por (resolved bools), symmetric_sets,
+    # dropped_sets, safe_actions
+    "reduce": {"states_pruned": _NUM, "ample_hit_rate": _NUM,
+               "orbit_factor": _NUM, "generated": _NUM,
+               "distinct": _NUM},
     # -- serve-plane scheduling (serve.scheduler, ISSUE 17) ----------------
     # one per scheduler decision, written to the scheduler's own
     # journal (root/sched.journal.jsonl): action in ("admit", "reject",

@@ -150,6 +150,17 @@ def metrics_from_events(events) -> dict:
         out["infer_certified"] = inf["certified"]
         if "n_states" in inf:
             out["infer_evidence_states"] = inf["n_states"]
+    red = next((e for e in reversed(events) if e["event"] == "reduce"),
+               None)
+    if red is not None:
+        # state-space reduction (ISSUE 18): what symmetry/POR bought
+        # this run, as Prometheus gauges (jaxtlc_reduce_*) - the
+        # transitions the ample sets cut, their hit rate, and the
+        # orbit factor the canonicalization divides the space by
+        out["reduce_states_pruned"] = red["states_pruned"]
+        out["reduce_ample_hit_rate"] = red["ample_hit_rate"]
+        out["reduce_orbit_factor"] = red["orbit_factor"]
+        out["reduce_distinct"] = red["distinct"]
     sched_evs = [e for e in events if e["event"] == "sched"]
     if sched_evs:
         # serve-plane control decisions (ISSUE 17): the scheduler's
@@ -229,6 +240,25 @@ def render_tlc_event(log, ev: dict, resume_cmd: str = "") -> None:
                 f"({ev['findings']} finding(s) total).",
                 severity=1,
             )
+    elif kind == "level" and ev.get("sym_violation"):
+        # the ring's sticky COL_SYM flag: the runtime orbit check
+        # caught the symmetry canonicalization NOT constant on a
+        # reachable orbit - loud once per run; the driver escalates
+        # the verdict to error
+        if not getattr(log, "_warned_sym_violation", False):
+            log._warned_sym_violation = True
+            log.msg(
+                1000,
+                "ERROR: runtime orbit-certificate violation - the "
+                "symmetry canonicalization mapped members of one "
+                "reachable orbit to different representatives "
+                "(jaxtlc.engine.reduce); the reduced run's results "
+                "are NOT trustworthy.  Re-run with -no-symmetry and "
+                "report the spec.",
+                severity=1,
+            )
+        if ev.get("cert_violation") or ev.get("counter_overflow"):
+            render_tlc_event(log, {**ev, "sym_violation": False})
     elif kind == "level" and ev.get("cert_violation"):
         # the ring's sticky COL_CERT flag: a generated state violated a
         # bound the certified abstract interpretation claimed - loud
@@ -386,6 +416,12 @@ _BENCH_BASE = {
     # or the invariant-inference predicates x states filter (True -
     # predicate-evals/s payloads, bench.py --infer)
     "infer": False,
+    # which state space produced the number (ISSUE 18): the full one
+    # (False/False) or one shrunk by symmetry canonicalization /
+    # partial-order ample-set pruning (bench.py --reduce-ab puts the
+    # reduced engine's settings in explicitly)
+    "symmetry": False,
+    "por": False,
 }
 
 

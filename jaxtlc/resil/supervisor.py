@@ -286,7 +286,7 @@ class SingleDeviceAdapter:
     GEOM_KEYS = ("queue_capacity", "fp_capacity")
     FIXED_KEYS = ("format", "config", "chunk", "fp_index", "seed",
                   "fp_highwater", "pipeline", "obs_slots", "coverage",
-                  "sort_free", "deferred")
+                  "sort_free", "deferred", "symmetry", "por")
 
     def __init__(self, cfg, chunk: int = 1024,
                  fp_index: int = DEFAULT_FP_INDEX, seed: int = DEFAULT_SEED,
@@ -323,6 +323,12 @@ class SingleDeviceAdapter:
         # True iff the engine actually carries the coverage leaves
         self.coverage = (backend is not None
                          and backend.coverage is not None)
+        # reduction flags ride the backend the same way: a reduced run
+        # explores a different (smaller) frontier, so resuming across
+        # a flag change must mismatch loudly (checkpoint meta keys)
+        red = getattr(backend, "reduce", None)
+        self.symmetry = bool(red is not None and red.plan is not None)
+        self.por = bool(red is not None and red.por and red.safe_ids)
 
     def build(self, params: dict, ckpt_every: int):
         # donate=False: the supervisor feeds the SAME last-good carry
@@ -369,6 +375,7 @@ class SingleDeviceAdapter:
             fp_highwater=self.fp_highwater, pipeline=self.pipeline,
             obs_slots=self.obs_slots, coverage=self.coverage,
             sort_free=self.sort_free, deferred=self.deferred,
+            symmetry=self.symmetry, por=self.por,
             **params,
         )
 
@@ -513,7 +520,7 @@ class ShardedAdapter:
     GEOM_KEYS = ("queue_capacity", "fp_capacity", "route_factor")
     FIXED_KEYS = ("format", "config", "devices", "fp_highwater",
                   "pipeline", "obs_slots", "coverage", "sort_free",
-                  "deferred")
+                  "deferred", "symmetry", "por")
 
     def __init__(self, cfg, mesh, chunk: int = 512, backend=None,
                  meta_config: dict = None,
@@ -536,6 +543,9 @@ class ShardedAdapter:
         self.pipeline = pipeline
         self.obs_slots = obs_slots
         self.coverage = self.backend.coverage is not None
+        red = getattr(self.backend, "reduce", None)
+        self.symmetry = bool(red is not None and red.plan is not None)
+        self.por = bool(red is not None and red.por and red.safe_ids)
 
     def build(self, params: dict, ckpt_every: int):
         from ..engine.sharded import make_sharded_engine
@@ -560,6 +570,7 @@ class ShardedAdapter:
             fp_highwater=self.fp_highwater, pipeline=self.pipeline,
             obs_slots=self.obs_slots, coverage=self.coverage,
             sort_free=self.sort_free, deferred=self.deferred,
+            symmetry=self.symmetry, por=self.por,
             **params,
         )
 
@@ -632,10 +643,11 @@ def _params_from_meta(adapter, meta: dict, params: dict) -> dict:
     want = adapter.meta(params)
     for key in adapter.FIXED_KEYS:
         # pre-pipeline/pre-obs/pre-coverage/pre-sort-free/pre-
-        # deferred snapshots carry no key: they were cut from engines
-        # without those features, so missing means off
+        # deferred/pre-reduction snapshots carry no key: they were cut
+        # from engines without those features, so missing means off
         have = meta.get(key, False if key in ("pipeline", "coverage",
-                                              "sort_free", "deferred")
+                                              "sort_free", "deferred",
+                                              "symmetry", "por")
                         else 0 if key == "obs_slots" else None)
         if have != want.get(key):
             raise ValueError(

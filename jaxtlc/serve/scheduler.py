@@ -110,7 +110,8 @@ TERMINAL_STATES = ("done", "error", "expired", "canceled", "quarantined")
 # job options forwarded to api.CheckRequest on the supervised path
 _REQUEST_OPTIONS = (
     "workers", "frontend", "chunk", "qcap", "fpcap", "pipeline",
-    "sortfree", "deferredinv", "sharded", "checkpoint", "checkpointevery",
+    "sortfree", "deferredinv", "symmetry", "por",
+    "sharded", "checkpoint", "checkpointevery",
     "recover", "liveness",
     "fairness", "nodeadlock", "faults", "retry", "maxregrow", "spill",
     "obs", "obsslots", "coverage", "recheck", "noartifactcache",

@@ -90,7 +90,9 @@ def struct_backend(model: StructModel,
                    check_deadlock: bool = True,
                    bounds=None,
                    elide: bool = True,
-                   coverage: bool = False) -> SpecBackend:
+                   coverage: bool = False,
+                   symmetry: bool = False,
+                   por: bool = False) -> SpecBackend:
     """Compile `model` into a SpecBackend: parse -> shape-infer ->
     lane-compile, the pipeline struct.cache memoizes in-process.
 
@@ -116,7 +118,17 @@ def struct_backend(model: StructModel,
     site table opens with one "action" site per action (the PR 3
     per-action coverage lines are a prefix view of per-site coverage).
     Pure telemetry: coverage-on results are bit-for-bit coverage-off
-    results."""
+    results.
+
+    `symmetry` / `por` (RESOLVED bools; the tri-state flags resolve via
+    engine.bfs.resolve_symmetry / resolve_por) attach the state-space
+    reduction capability (engine.reduce.ReduceOps, ISSUE 18):
+    symmetry canonicalizes every successor to its orbit representative
+    over the statically-verified symmetric constant sets
+    (analysis.symfind) before fingerprinting, POR prunes commutative
+    interleavings through singleton ample sets.  Verdicts, invariant
+    outcomes and rendered traces are preserved; DISTINCT/GENERATED
+    counts legitimately shrink, which is why both default off."""
     system = model.system
     trap_policy = None
     cert = False
@@ -213,6 +225,35 @@ def struct_backend(model: StructModel,
         plane = CoveragePlane(sites=sites, count=cov_count,
                               module=model.root_name)
 
+    reduce_ops = None
+    if symmetry or por:
+        from ..analysis.speclint import analyze_spec
+        from ..analysis.symfind import analyze_reduction
+        from ..engine.reduce import ReduceOps, build_plan
+
+        rep = analyze_reduction(
+            model, analyze_spec(model, var_shapes=var_shapes)
+        )
+        plan, dropped = (None, {})
+        if symmetry:
+            plan, dropped = build_plan(cdc, rep.symmetric_sets)
+        safe_ids: Tuple[int, ...] = ()
+        if por:
+            safe_ids = tuple(
+                action_names.index(a) for a in rep.safe_actions
+                if a in action_names
+            )
+        reduce_ops = ReduceOps(
+            plan=plan,
+            safe_ids=safe_ids,
+            por=bool(por),
+            sym_sets=tuple(sorted(plan.sym_sets.items()))
+            if plan is not None else (),
+            dropped_sets=tuple(sorted(
+                {**rep.rejected_sets, **dropped}.items()
+            )),
+        )
+
     viol_names = struct_viol_names(model)
     if bounds is not None:
         from ..engine.bfs import VIOL_SLOT_OVERFLOW
@@ -238,6 +279,7 @@ def struct_backend(model: StructModel,
         check_deadlock=check_deadlock,
         cert_check=cert_check,
         coverage=plane,
+        reduce=reduce_ops,
     )
     # trap-audit surface (preflight renders which traps remain and why)
     backend.cdc.trap_stats = trap_stats

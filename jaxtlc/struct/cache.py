@@ -181,7 +181,8 @@ def _bounds_key(bounds) -> str:
 
 
 def get_backend(model, check_deadlock: bool = True, bounds=None,
-                elide: bool = True, coverage: bool = False):
+                elide: bool = True, coverage: bool = False,
+                symmetry: bool = False, por: bool = False):
     """Memoized struct_backend (the parse -> shape-infer -> lane-compile
     pipeline runs once per spec meaning per process).  `bounds` (a
     certified analysis.absint.BoundReport) selects the NARROWED
@@ -189,17 +190,21 @@ def get_backend(model, check_deadlock: bool = True, bounds=None,
     `elide=False` keeps every trap (the sharded engines' narrowed
     form, which has no certificate column).  `coverage` compiles the
     device coverage plane in (a distinct memo entry: the backend
-    carries the site table + count hook)."""
+    carries the site table + count hook).  `symmetry`/`por` (resolved
+    bools) attach the state-space reduction ops - distinct memo
+    entries because the reduced engine has a different carry layout
+    (COL_SYM ring column, prune counters) and different step XLA."""
     from .backend import struct_backend
 
     enable_persistent_cache()
     key = (model_key(model), bool(check_deadlock), _bounds_key(bounds),
-           bool(elide), bool(coverage))
+           bool(elide), bool(coverage), bool(symmetry), bool(por))
     hit = _BACKEND_MEMO.get(key)
     if hit is None:
         hit = struct_backend(model, check_deadlock=check_deadlock,
                              bounds=bounds, elide=elide,
-                             coverage=coverage)
+                             coverage=coverage, symmetry=symmetry,
+                             por=por)
         _BACKEND_MEMO.put(key, hit)
     return hit
 
@@ -219,6 +224,8 @@ def engine_key(
     coverage: bool = False,
     sort_free: bool = None,
     deferred: bool = None,
+    symmetry: bool = None,
+    por: bool = None,
 ) -> tuple:
     """The full engine-memo key: spec meaning (digest + canonical
     constants + invariants) x engine geometry x pipeline/obs/coverage/
@@ -227,11 +234,18 @@ def engine_key(
     the bounds; a covered engine carries the coverage leaves; a
     sort-free engine compiles the hash-slab commit; a deferred
     engine moves invariant/cert evaluation to the commit stage, ISSUE
-    15).  The serve EnginePool keys its warm AOT entries on exactly
-    this tuple so pool identity and memo identity cannot drift.
-    `sort_free` and `deferred` are resolved (tri-state auto -> bool)
-    against the chunk so the key never depends on who asked."""
-    from ..engine.bfs import resolve_deferred, resolve_sort_free
+    15; a symmetry/POR-reduced engine canonicalizes and prunes in the
+    expand stage, ISSUE 18).  The serve EnginePool keys its warm AOT
+    entries on exactly this tuple so pool identity and memo identity
+    cannot drift.  `sort_free`/`deferred`/`symmetry`/`por` are
+    resolved (tri-state auto -> bool) against the chunk so the key
+    never depends on who asked."""
+    from ..engine.bfs import (
+        resolve_deferred,
+        resolve_por,
+        resolve_sort_free,
+        resolve_symmetry,
+    )
 
     return (
         model_key(model), "single", chunk, queue_capacity, fp_capacity,
@@ -239,6 +253,7 @@ def engine_key(
         bool(pipeline), int(obs_slots), _bounds_key(bounds),
         bool(coverage), resolve_sort_free(sort_free, chunk),
         resolve_deferred(deferred, chunk),
+        resolve_symmetry(symmetry, chunk), resolve_por(por, chunk),
     )
 
 
@@ -257,6 +272,8 @@ def get_engine(
     coverage: bool = False,
     sort_free: bool = None,
     deferred: bool = None,
+    symmetry: bool = None,
+    por: bool = None,
 ) -> Tuple:
     """Memoized single-device engine triple (init_fn, run_fn, step_fn)
     for a struct model; enables the persistent XLA cache as a side
@@ -267,20 +284,28 @@ def get_engine(
     bound digest); `coverage` the covered engine (per-site counter
     leaves on the carry); `sort_free` the hash-slab commit (resolved
     against the chunk, so an auto caller and an explicit caller at the
-    same geometry share one memo entry)."""
-    from ..engine.bfs import make_backend_engine
+    same geometry share one memo entry); `symmetry`/`por` the reduced
+    engine (orbit canonicalization + ample-set pruning, ISSUE 18)."""
+    from ..engine.bfs import (
+        make_backend_engine,
+        resolve_por,
+        resolve_symmetry,
+    )
 
     enable_persistent_cache()
     key = engine_key(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
         obs_slots=obs_slots, bounds=bounds, coverage=coverage,
-        sort_free=sort_free, deferred=deferred,
+        sort_free=sort_free, deferred=deferred, symmetry=symmetry,
+        por=por,
     )
     hit = _ENGINE_MEMO.get(key)
     if hit is None:
         backend = get_backend(model, check_deadlock, bounds=bounds,
-                              coverage=coverage)
+                              coverage=coverage,
+                              symmetry=resolve_symmetry(symmetry, chunk),
+                              por=resolve_por(por, chunk))
         hit = make_backend_engine(
             backend, chunk, queue_capacity, fp_capacity, fp_index, seed,
             fp_highwater=fp_highwater, pipeline=pipeline,

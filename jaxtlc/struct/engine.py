@@ -57,6 +57,8 @@ def check_struct(
     coverage: bool = False,
     sort_free: bool = None,
     deferred: bool = None,
+    symmetry: bool = None,
+    por: bool = None,
     capture_fps: bool = False,
 ) -> CheckResult:
     """Exhaustive device check of a struct-compiled spec (single device,
@@ -65,17 +67,25 @@ def check_struct(
     with the runtime certificate check on; `coverage` the covered
     engine (device per-site coverage on CheckResult.site_coverage);
     `sort_free` the hash-slab commit (bit-identical results);
+    `symmetry`/`por` the state-space-reduced engine (orbit
+    canonicalization with the runtime orbit certificate + ample-set
+    pruning - same verdict, legitimately fewer states, ISSUE 18);
     `capture_fps` reads the final fingerprint table back to host on a
     clean verdict (CheckResult.fp_table - the artifact cache's
     reachable-set source, struct.artifacts)."""
+    from ..engine.bfs import resolve_por, resolve_symmetry
+
     init_fn, run_fn, _ = get_engine(
         model, chunk, queue_capacity, fp_capacity, fp_index, seed,
         fp_highwater, check_deadlock=check_deadlock, pipeline=pipeline,
         obs_slots=obs_slots, bounds=bounds, coverage=coverage,
-        sort_free=sort_free, deferred=deferred,
+        sort_free=sort_free, deferred=deferred, symmetry=symmetry,
+        por=por,
     )
     backend = get_backend(model, check_deadlock, bounds=bounds,
-                          coverage=coverage)
+                          coverage=coverage,
+                          symmetry=resolve_symmetry(symmetry, chunk),
+                          por=resolve_por(por, chunk))
     carry = init_fn()
     compiled = run_fn.lower(carry).compile()
     t0 = time.time()
@@ -109,6 +119,8 @@ def check_struct_sharded(
     coverage: bool = False,
     sort_free: bool = None,
     deferred: bool = None,
+    symmetry: bool = None,
+    por: bool = None,
 ) -> CheckResult:
     """Exhaustive mesh-sharded check of a struct-compiled spec
     (capacities PER DEVICE; fingerprint-space all_to_all partitioning,
@@ -116,11 +128,18 @@ def check_struct_sharded(
     `bounds` narrows the codec; the mesh engine has no certificate
     column yet, so every trap stays compiled in (elide=False) and the
     encode traps carry the soundness story there.  `coverage` carries
-    the per-device coverage partials, summed at readback."""
+    the per-device coverage partials, summed at readback.
+    `symmetry`/`por` reduce the state space before routing: orbit
+    canonicalization runs pre-fingerprint so representatives shard
+    consistently (the fingerprint is a pure function of the canonical
+    packed words on every device)."""
+    from ..engine.bfs import resolve_por, resolve_symmetry
     from ..engine.sharded import check_sharded
 
     backend = get_backend(model, check_deadlock, bounds=bounds,
-                          elide=False, coverage=coverage)
+                          elide=False, coverage=coverage,
+                          symmetry=resolve_symmetry(symmetry, chunk),
+                          por=resolve_por(por, chunk))
     return check_sharded(
         None, mesh, chunk=chunk, queue_capacity=queue_capacity,
         fp_capacity=fp_capacity, route_factor=route_factor,

@@ -420,7 +420,7 @@ def test_lintgate_specs_tree_clean():
     rc = run_gate("specs", out=out)
     text = out.getvalue()
     assert rc == 0, text
-    assert "lint gate: 5 spec(s)" in text
+    assert "lint gate: 6 spec(s)" in text
     assert "0 new error(s)" in text
     # the gate genuinely ran absint: the word-reducing RaftReplication
     # narrowing shows up as its info finding

@@ -542,8 +542,8 @@ def test_selfcheck_registry_pinned():
 
     assert sorted(FACTORIES) == [
         "covered", "deferred", "enumerator", "fused", "infer",
-        "narrowed", "phased", "pipelined", "sharded", "sim",
-        "sortfree", "spill", "struct", "sweep",
+        "narrowed", "phased", "pipelined", "por", "sharded", "sim",
+        "sortfree", "spill", "struct", "sweep", "symmetry",
     ]
 
 

@@ -270,6 +270,10 @@ def test_bench_emit_enforces_payload_contract(capsys):
         # ISSUE 15: which EXPAND mode produced the number (immediate
         # per-candidate vs distinct-first deferred inv/cert) too
         assert "deferred" in payload
+        # ISSUE 18: which STATE SPACE produced the number (full vs
+        # symmetry-canonicalized / POR-pruned) rides every payload
+        assert "symmetry" in payload
+        assert "por" in payload
     # both emissions were journaled as validated bench_metric events
     kinds = [e["event"] for e in bench._JOURNAL.events]
     assert kinds.count("bench_metric") == 2

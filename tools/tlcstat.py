@@ -160,6 +160,18 @@ def render(events) -> str:
             f"[{inf.get('evidence', '?')} x "
             f"{inf.get('n_states', 0):,} states]"
         )
+    # state-space reduction (engine.reduce): what symmetry/POR bought
+    # the most recent reduced run - the orbit factor the space was
+    # divided by and the transitions the ample sets cut pre-dedup
+    red = next((e for e in reversed(events) if e["event"] == "reduce"),
+               None)
+    if red is not None:
+        lines.append(
+            f"reduction: orbit factor {red['orbit_factor']}x  |  "
+            f"{red['states_pruned']:,} transitions POR-pruned "
+            f"({red['ample_hit_rate']:.1%} of expansion)  |  "
+            f"{red['distinct']:,} distinct representatives"
+        )
     # incremental re-checking (struct.artifacts): this run's artifact
     # cache decisions - a hit means the verdict was replayed (or BFS
     # skipped) instead of re-explored
