@@ -76,6 +76,14 @@ Usage:
                                # identical-verdict gated and orbit-
                                # certificate gated (the ISSUE 18
                                # soundness contract)
+    python bench.py --multihost-ab  # localhost jax.distributed pod
+                               # scaling (ISSUE 19): 1x8 / 2x4 / 4x2
+                               # processes x devices over KubeAPI FF,
+                               # exact-count gated per row, plus the
+                               # over-capacity leg that completes ONLY
+                               # with the per-host spill lifeboat;
+                               # emits multihost_scaling_x and writes
+                               # MULTICHIP_r06.json
     python bench.py --sim      # simulation tier (ISSUE 14): Model_1
                                # random walks vs the chunk-matched BFS
                                # engine, both AOT once, interleaved
@@ -1393,9 +1401,170 @@ def bench_infer(probe_err: str) -> int:
     return 0
 
 
+def bench_multihost_ab(probe_err: str) -> int:
+    """--multihost-ab: localhost jax.distributed pod scaling A/B.
+
+    Spawns N coordinator+worker pods on loopback (python -m jaxtlc.dist
+    --spawn N, gloo collectives) over the KubeAPI FF workload at a
+    CONSTANT total device count - 1x8, 2x4, 4x2 processes x devices -
+    so the delta between rows is pure multi-process overhead (the
+    level-fence all_to_all crossing process boundaries).  Every row is
+    gated on the exact oracle counts; peak per-host shard occupancy is
+    read back from the per-host journals (obs.views.pod_host_gauges).
+
+    Then the over-capacity demonstration: a pod whose per-host tables
+    are too small for the state space (4 x 1024 slots < 8,203 distinct)
+    must FAIL without the spill lifeboat and complete EXACTLY with
+    --spill on - capacity beyond one host's memory is the point of the
+    pod + spill combination, and this leg commits the evidence.
+
+    Emits a `multihost_scaling_x` metric line and writes the full
+    table to MULTICHIP_r06.json at the repo root."""
+    import json as _json
+    import os
+    import subprocess
+    import tempfile
+
+    expect = (17020, 8203, 109)  # KubeAPI FF oracle (BASELINE.md)
+    art_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "MULTICHIP_r06.json")
+    art = {"mode": "multihost_ab", "workload": "kubeapi_ff",
+           "expect": list(expect), "table": [], "overcap": {},
+           "ok": False}
+
+    def _commit_art() -> None:
+        with open(art_path, "w") as f:
+            _json.dump(art, f, indent=2)
+            f.write("\n")
+
+    def _pod(procs: int, dph: int, fpcap: int, spill: bool,
+             ckpt: str, timeout_s: int) -> dict:
+        """One localhost pod run -> parsed POD_RESULT (+ peak per-host
+        shard occupancy from the journals) or an error dict."""
+        cmd = [sys.executable, "-m", "jaxtlc.dist",
+               "--spawn", str(procs), "--devices-per-host", str(dph),
+               "--ff", "--chunk", "128", "--queue-capacity", "4096",
+               "--fp-capacity", str(fpcap), "--ckpt", ckpt]
+        if spill:
+            cmd += ["--spill", "on", "--spill-capacity", str(1 << 15)]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # workers size their own virtual-device mesh from
+        # --devices-per-host; an inherited count would override it
+        env.pop("XLA_FLAGS", None)
+        try:
+            proc = subprocess.run(cmd, env=env, timeout=timeout_s,
+                                  capture_output=True, text=True,
+                                  cwd=os.path.dirname(art_path))
+        except subprocess.TimeoutExpired:
+            return {"error": f"pod timed out > {timeout_s}s"}
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("POD_RESULT ")), None)
+        if proc.returncode != 0 or line is None:
+            tail = (proc.stdout + proc.stderr).strip().splitlines()[-3:]
+            return {"error": f"rc={proc.returncode} {tail}"}
+        out = _json.loads(line[len("POD_RESULT "):])
+        peak = 0.0
+        for h in range(procs):
+            jp = f"{ckpt}.h{h}.journal.jsonl"
+            if os.path.exists(jp):
+                from jaxtlc.obs import journal as _jr
+                from jaxtlc.obs.views import pod_host_gauges
+
+                g = pod_host_gauges(_jr.read(jp, validate=False))
+                if g:
+                    peak = max(peak, *(
+                        v["shard_occupancy"] for v in g.values()))
+        out["peak_shard_occupancy"] = round(peak, 4)
+        return out
+
+    rows = []
+    with tempfile.TemporaryDirectory() as d:
+        for procs, dph in ((1, 8), (2, 4), (4, 2)):
+            r = _pod(procs, dph, fpcap=16384, spill=False,
+                     ckpt=os.path.join(d, f"ab{procs}.ckpt"),
+                     timeout_s=600)
+            row = {"procs": procs, "devices_per_host": dph, **{
+                k: r.get(k) for k in
+                ("generated", "distinct", "depth", "wall_s",
+                 "peak_shard_occupancy", "error")
+                if k in r or k != "error"}}
+            counts = (r.get("generated"), r.get("distinct"),
+                      r.get("depth"))
+            row["ok"] = "error" not in r and counts == expect \
+                and r.get("rc") == 0
+            if row["ok"]:
+                row["states_per_s"] = round(r["distinct"] / r["wall_s"],
+                                            1)
+            rows.append(row)
+            art["table"] = rows
+            _commit_art()
+            if not row["ok"]:
+                _emit({"error": f"{procs}-process pod failed: "
+                                f"{r.get('error', counts)}",
+                       "workload": "kubeapi_ff_pod"})
+                return 1
+
+        # over-capacity: 4 x 1024 table slots < 8,203 distinct states.
+        # Without spill the pod MUST fail (table overflow is detected,
+        # not silently wrong); with the per-host spill lifeboat it must
+        # complete bit-exactly.
+        nosp = _pod(2, 2, fpcap=1024, spill=False,
+                    ckpt=os.path.join(d, "oc_nospill.ckpt"),
+                    timeout_s=300)
+        nosp_completed = ("error" not in nosp and nosp.get("rc") == 0
+                          and (nosp.get("generated"),
+                               nosp.get("distinct"),
+                               nosp.get("depth")) == expect)
+        sp = _pod(2, 2, fpcap=1024, spill=True,
+                  ckpt=os.path.join(d, "oc_spill.ckpt"), timeout_s=600)
+        sp_ok = ("error" not in sp and sp.get("rc") == 0
+                 and (sp.get("generated"), sp.get("distinct"),
+                      sp.get("depth")) == expect)
+        art["overcap"] = {
+            "fp_capacity_total": 4 * 1024,
+            "no_spill": {"completed": nosp_completed,
+                         "detail": nosp.get("error",
+                                            f"rc={nosp.get('rc')}")},
+            "spill": {k: sp.get(k) for k in
+                      ("generated", "distinct", "depth", "wall_s",
+                       "spilled", "spill_flushes")} | {"ok": sp_ok},
+        }
+        _commit_art()
+        if nosp_completed:
+            _emit({"error": "over-capacity pod completed WITHOUT "
+                            "spill - the table-overflow gate is gone",
+                   "workload": "kubeapi_ff_pod"})
+            return 1
+        if not sp_ok:
+            _emit({"error": f"over-capacity spill pod failed: "
+                            f"{sp.get('error', sp)}",
+                   "workload": "kubeapi_ff_pod"})
+            return 1
+
+    r1, r2, r4 = (row["states_per_s"] for row in rows)
+    art["ok"] = True
+    _commit_art()
+    _emit({
+        "metric": "multihost_scaling_x",
+        "value": round(r4 / r1, 3),
+        "unit": "x",
+        "vs_baseline": round(r4 / r1, 3),
+        "workload": "kubeapi_ff_pod",
+        "states_per_s_1x8": r1,
+        "states_per_s_2x4": r2,
+        "states_per_s_4x2": r4,
+        "overcap_spilled": art["overcap"]["spill"]["spilled"],
+        "artifact": "MULTICHIP_r06.json",
+        "device": "cpu pod (gloo loopback)",
+    })
+    return 0
+
+
 def main() -> int:
     device_note = ""
     probe_err = _probe_backend()
+    if "--multihost-ab" in sys.argv:
+        return bench_multihost_ab(probe_err)
     if "--infer" in sys.argv:
         return bench_infer(probe_err)
     if "--sim" in sys.argv:

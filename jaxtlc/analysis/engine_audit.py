@@ -218,8 +218,14 @@ def describe_engine(name: str, fn, carry,
         ("cond", {"cond"}),
         ("sort", {"sort"}),
         ("gather", {"gather", "dynamic_slice"}),
-        ("collective", {"all_to_all", "psum", "pmax", "all_gather",
-                        "ppermute"}),
+        # ragged_all_to_all / reduce_scatter are how newer jax lowers
+        # the cross-host (DCN) exchange of a multi-process pod mesh
+        # (jaxtlc.dist); they must classify as collective, not fall
+        # through as unknown primitives, or the census would report a
+        # pod engine as collective-free
+        ("collective", {"all_to_all", "psum", "pmax", "pmin",
+                        "all_gather", "ppermute", "ragged_all_to_all",
+                        "reduce_scatter"}),
         ("callback", CALLBACK_PRIMS),
     ):
         if prims & members:

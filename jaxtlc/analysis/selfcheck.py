@@ -372,6 +372,30 @@ def _build_spill():
                 fp_capacity=_TINY["fp_capacity"])
 
 
+def _build_shardspill():
+    # the spill-capable MESH engine (ISSUE 19): the audited step is the
+    # expand half (candidate-routing all_to_all + owner fpset_member
+    # filter) composed with the veto commit half; the host SpillStore
+    # probe sits between the two shard_map dispatches in production,
+    # outside any device body - exactly what the purity audit verifies
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..engine.sharded import ShardedSpillRuntime
+    from ..engine.spill import SpillStore
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("fp",))
+    rt = ShardedSpillRuntime(
+        None, mesh, _TINY["chunk"], _TINY["queue_capacity"],
+        _TINY["fp_capacity"], backend=_ff_backend(),
+        store=SpillStore(1 << 10),
+    )
+    return dict(init_fn=rt.init_fn, step_fn=rt.audit_step_fn,
+                n_lanes=_ff_backend().n_lanes,
+                fp_capacity=_TINY["fp_capacity"])
+
+
 _SWEEP_SPEC = """---- MODULE SweepAudit ----
 EXTENDS Naturals
 CONSTANTS MAX
@@ -460,6 +484,7 @@ FACTORIES: Dict[str, Callable[[], dict]] = {
     "pipelined": _build_pipelined,
     "por": _build_por,
     "sharded": _build_sharded,
+    "shardspill": _build_shardspill,
     "sim": _build_sim,
     "sortfree": _build_sortfree,
     "spill": _build_spill,

@@ -1,0 +1,38 @@
+"""jaxtlc.dist: jax.distributed multi-host pods for the sharded engine.
+
+One process per host, one global mesh over every host's devices, the
+same compiled `make_sharded_engine` body throughout - the candidate-
+routing `all_to_all` simply crosses DCN between hosts at the level-fence
+seam the deferred collective already batches (engine/sharded.py module
+docstring, "Topology").  This package adds only what distribution
+genuinely needs on top:
+
+* `pod.init_pod` / `pod.pod_mesh` - jax.distributed bring-up (gloo
+  collectives on CPU pods) and the global "fp" mesh;
+* `pod.run_pod` - the pod driver: AOT segment loop, per-host journals
+  (`{base}.h{pid}.journal.jsonl`, merged by obs.serve's /runs registry
+  and obs.views.merge_journals), per-host shard checkpoints
+  (`{base}.h{pid}`), SIGTERM consensus (one preempted host checkpoints
+  EVERY host via a pod-wide pmax vote, exit 75), and the per-host
+  SpillStore lifeboat (`spill="on"`, ShardedSpillRuntime);
+* `pod.reshard_carry` - resume at a DIFFERENT pod width: re-partitions
+  saved table fingerprints and frontier states by the new owner mapping
+  hi & (D'-1), host-side and exact.
+
+`python -m jaxtlc.dist --spawn N` launches an N-process localhost pod
+(the test/bench topology); see __main__.py.
+"""
+
+from .pod import (  # noqa: F401
+    DEFAULT_COORDINATOR,
+    EXIT_OK,
+    EXIT_PREEMPTED,
+    EXIT_VIOLATION,
+    PodResult,
+    host_checkpoint_path,
+    host_journal_path,
+    init_pod,
+    pod_mesh,
+    reshard_carry,
+    run_pod,
+)

@@ -17,16 +17,32 @@ This module is the TPU-native equivalent (SURVEY.md §2.3 E12, §2.4):
   with exact depth, lock-step across the mesh inside one `lax.while_loop`
   under `shard_map`.
 
-Multi-host scaling is the same code over a multi-host mesh (jax spans DCN
-transparently); no RMI analog is needed.  The driver validates this path on
-a virtual 8-device CPU mesh (`__graft_entry__.dryrun_multichip`).
+Topology (ISSUE 19): the SAME compiled body runs single-process (one
+process owns every mesh device - the tested default, and the 8-device
+virtual-mesh dryrun `__graft_entry__.dryrun_multichip`) and
+multi-process (`jax.distributed` pods, jaxtlc.dist: one process per
+host, the global mesh spanning all of them, the candidate-routing
+`all_to_all` crossing DCN at exactly the level-fence seam the deferred
+collective already batches).  Process membership is NOT elastic inside
+a dispatch: a host that must leave checkpoints its shard slice and the
+pod relaunches at the new width through the reshard-on-recover path
+(jaxtlc.dist.pod.reshard_carry), which re-partitions table fingerprints
+and frontier states by the new owner mapping hi & (D'-1).
 
-Capacity ladder note: the sharded engine has no host spill tier yet
-(SPILL_CAPABLE below) - per-device tables would each need their own
-host store plus a routing-aware flush, which is the ROADMAP #2/#3
-composition.  The supervisor's degradation ladder therefore skips the
-spill rung for sharded runs: a denied per-device fpset regrow falls
-through to checkpoint + exit 75 with the resume command.
+Capacity ladder note: the sharded engine now HAS a host spill tier
+(SPILL_CAPABLE below, ISSUE 19 closing ROADMAP #1's pinned gap): the
+fused body is split at the owner seam into `expand_half` (pop, expand,
+route, owner-side `fpset_member` filter) and `commit_half` (owner-side
+slab insert, deferred invariants, verdict return, level fences), and
+`ShardedSpillRuntime` drives the two jitted halves from the host with a
+per-host SpillStore probe in between - each host's local device tables
+flush into that host's store at the fp_highwater load, exactly the
+engine.spill lifeboat, shard by shard.  The fused engine composes the
+same two halves back into one `lax.while_loop` body, so there is one
+implementation and no drift; the PR 12 owner-side slab insert and the
+PR 15 owner-side distinct-first deferred invariant evaluation both live
+in `commit_half` and therefore run identically on the fused, spill and
+pod paths.
 """
 
 from __future__ import annotations
@@ -47,9 +63,16 @@ except ImportError:  # pragma: no cover - older jax keeps it experimental
 from jax.sharding import Mesh, PartitionSpec as P
 
 # the supervisor's degradation ladder consults this before offering the
-# host spill tier (module docstring: per-device stores + routing-aware
-# flush are the ROADMAP #2/#3 composition, not built yet)
-SPILL_CAPABLE = False
+# host spill tier: ShardedSpillRuntime (below) drives the expand/commit
+# halves with a per-host SpillStore between them (ISSUE 19; unpipelined
+# sharded carries only - the adapter gates the pipeline case)
+SPILL_CAPABLE = True
+
+# spill-mode owner filter walk cap (engine.spill's MEMBER_ROUNDS): near
+# the highwater load ABSENT keys walk long full-bucket runs; unresolved
+# lanes safely degrade to a host probe, so a small cap bounds the device
+# filter at the price of a few extra host lookups
+SPILL_MEMBER_ROUNDS = 4
 
 
 def shard_map(f, mesh, in_specs, out_specs, **kw):
@@ -85,7 +108,7 @@ from .bfs import (
     outdegree_from_hist,
 )
 from .fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED, fp64_words
-from .fpset import FPSet, fpset_insert, host_insert
+from .fpset import FPSet, fpset_insert, fpset_member, host_insert
 
 
 # the frontend -> engine seam now lives in engine.backend (shared with
@@ -149,6 +172,50 @@ class ShardCarry(NamedTuple):
     # across the mesh axis at readback (engine.bfs.cov_totals), exactly
     # like the partial generated/distinct counters above.
     cov_counts: jnp.ndarray = None  # [D, n_sites] uint32
+    # --- host spill tier (None until ShardedSpillRuntime adopts) -------
+    # Per-device count of candidates the host store vetoed (they dedup
+    # exactly like a device-table hit); partials, psum'd at read-out
+    # like generated/distinct.
+    spill_hits: jnp.ndarray = None  # [D] uint32
+
+
+class ShardEx(NamedTuple):
+    """The expand-half -> commit-half seam of the sharded body (device-
+    level leaves, no mesh axis).  `expand_half` pops a chunk, expands,
+    canonicalizes, fingerprints and routes candidates to their owners
+    (the candidate-routing all_to_all is INSIDE expand); `commit_half`
+    performs the owner-side slab insert + deferred invariants +
+    enqueue + verdict return + level fencing.  The fused engine
+    composes the two back into one while_loop body (bit-identical op
+    graph); ShardedSpillRuntime runs them as separate jits with a
+    host SpillStore probe in between, exactly the engine.spill
+    expand/commit protocol lifted onto the mesh."""
+
+    outdeg0: jnp.ndarray  # [L+2] outdeg hist after the pipeline fold
+    act_dist0: jnp.ndarray  # [n_labels+1] act_dist after the fold
+    n: jnp.ndarray  # [] rows popped this chunk
+    mask: jnp.ndarray  # [chunk] popped-row mask
+    batch: jnp.ndarray  # [chunk, F] popped states
+    valid: jnp.ndarray  # [chunk, L] post-POR successor validity
+    flat: jnp.ndarray  # [ncand, F] canonicalized candidates
+    fvalid: jnp.ndarray  # [ncand]
+    faction: jnp.ndarray  # [ncand] candidate action ids
+    inv_bad: jnp.ndarray  # [n_inv, ncand] immediate-mode sweep (0 rows
+    #                       in deferred mode - the owner checks instead)
+    afail: jnp.ndarray  # [chunk, L] action assertion failures
+    ovf: jnp.ndarray  # [chunk, L] slot overflows
+    dead: jnp.ndarray  # [chunk] deadlocked popped states
+    order: jnp.ndarray  # [ncand] owner-sort permutation
+    s_own: jnp.ndarray  # [ncand] owner per sorted candidate
+    s_pos: jnp.ndarray  # [ncand] position within owner bucket
+    s_valid: jnp.ndarray  # [ncand] sorted-candidate validity
+    route_ovf: jnp.ndarray  # [] bucket overflow anywhere this device
+    r_flat: jnp.ndarray  # [D*B, F] received (owner-side) candidates
+    r_lo: jnp.ndarray  # [D*B] uint32 received fp low words
+    r_hi: jnp.ndarray  # [D*B] uint32 received fp high words
+    r_valid: jnp.ndarray  # [D*B] received-slot validity
+    member: jnp.ndarray  # [D*B] bounded owner-table membership filter
+    #                      (all-False when the spill filter is off)
 
 
 def route_bucket_width(chunk: int, n_lanes: int, D: int,
@@ -178,6 +245,7 @@ def make_sharded_engine(
     obs_slots: int = 0,
     sort_free: bool = None,
     deferred: bool = None,
+    _parts: dict = None,
 ):
     """Build (init_fn, run_fn) over `mesh` (single axis named "fp").
 
@@ -391,20 +459,21 @@ def make_sharded_engine(
         )
 
     # ---------------- per-device loop body --------------------------------
+    # Split at the owner seam (ISSUE 19): expand_half pops + expands +
+    # routes, commit_half owns insert/invariants/enqueue/fences.  The
+    # fused body below composes them back into the single while_loop
+    # body this engine always ran; ShardedSpillRuntime runs the halves
+    # as separate jits with a host SpillStore probe between them.
 
-    def body(c):
+    def expand_half(c, with_member: bool = False) -> ShardEx:
         # c leaves have their [D] axis stripped to size 1 by shard_map; we
         # index [0] for scalars and keep arrays as-is.
         (qhead,) = c.qhead
         (qtail,) = c.qtail
         (level_end,) = c.level_end
-        (level,) = c.level
-        (depth,) = c.depth
         (viol,) = c.viol
-        (viol_local,) = c.viol_local
         queue = c.queue[0]
         table = c.table[0]
-        viol_state = c.viol_state[0]
 
         # ---- deferred verdict return of chunk k-1 (pipeline mode) ----
         # issued FIRST so this collective can be in flight while chunk
@@ -522,12 +591,78 @@ def make_sharded_engine(
         r_hi = r[:, F + 1].astype(jnp.uint32)
         r_valid = r[:, F + 2] == 1
 
+        if with_member:
+            # spill-mode owner filter: bounded membership walk over the
+            # device table keeps definitely-old candidates off the host
+            # round trip (engine.spill's MEMBER_ROUNDS rationale:
+            # unresolved lanes safely degrade to a host probe)
+            member = fpset_member(FPSet(table), r_lo, r_hi, r_valid,
+                                  max_rounds=SPILL_MEMBER_ROUNDS)
+        else:
+            member = jnp.zeros(D * B, bool)
+
+        return ShardEx(
+            outdeg0=outdeg_hist0,
+            act_dist0=act_dist0,
+            n=n,
+            mask=mask,
+            batch=batch,
+            valid=valid,
+            flat=flat,
+            fvalid=fvalid,
+            faction=faction,
+            inv_bad=(jnp.stack(inv_bad) if inv_bad
+                     else jnp.zeros((0, ncand), bool)),
+            afail=afail,
+            ovf=ovf,
+            dead=dead,
+            order=order,
+            s_own=s_own,
+            s_pos=pos_in_bucket,
+            s_valid=s_valid,
+            route_ovf=route_ovf,
+            r_flat=r_flat,
+            r_lo=r_lo,
+            r_hi=r_hi,
+            r_valid=r_valid,
+            member=member,
+        )
+
+    def commit_half(c, ex: ShardEx, veto=None):
+        (qhead,) = c.qhead
+        (qtail,) = c.qtail
+        (level_end,) = c.level_end
+        (level,) = c.level
+        (depth,) = c.depth
+        (viol,) = c.viol
+        (viol_local,) = c.viol_local
+        queue = c.queue[0]
+        table = c.table[0]
+        viol_state = c.viol_state[0]
+        spill = veto is not None
+        (n, mask, batch, flat, fvalid, faction) = (
+            ex.n, ex.mask, ex.batch, ex.flat, ex.fvalid, ex.faction
+        )
+        (order, s_own, pos_in_bucket, s_valid, route_ovf) = (
+            ex.order, ex.s_own, ex.s_pos, ex.s_valid, ex.route_ovf
+        )
+        r_flat, r_lo, r_hi, r_valid = ex.r_flat, ex.r_lo, ex.r_hi, ex.r_valid
+        outdeg_hist0, act_dist0 = ex.outdeg0, ex.act_dist0
+        afail, ovf, dead, valid = ex.afail, ex.ovf, ex.dead, ex.valid
+        inv_bad = [ex.inv_bad[k] for k in range(ex.inv_bad.shape[0])]
+
         # ---- dedup + insert at owner ----
         my_distinct = c.distinct[0]
-        fp_full = (my_distinct.astype(jnp.int32) + D * B) > int(
-            fp_capacity * fp_highwater
-        )
-        ins_mask = r_valid & ~fp_full
+        if spill:
+            # the runtime's pre-step flush guarantees table room, and a
+            # host-vetoed candidate dedups exactly like a table hit
+            fp_full = jnp.bool_(False)
+            ins_mask = r_valid & ~veto
+        else:
+            fp_full = (my_distinct.astype(jnp.int32) + D * B) > int(
+                fp_capacity * fp_highwater
+            )
+            ins_mask = r_valid & ~fp_full
         if deferred:
             # same computation fpset_insert performs, with the
             # compacted (is_new_c, c_idx, nreps) kept for the
@@ -726,6 +861,14 @@ def make_sharded_engine(
                 pv_faction=faction.astype(jnp.int32)[None],
                 pv_n=n[None],
             )
+        sp = {}
+        if c.spill_hits is not None:
+            hits = c.spill_hits[0]
+            if spill:
+                # host-vetoed candidates dedup like table hits; the
+                # count is pure telemetry (SupervisedResult.spill_hits)
+                hits = hits + (veto & r_valid).sum().astype(jnp.uint32)
+            sp = dict(spill_hits=hits[None])
 
         return ShardCarry(
             table=fset.table[None],
@@ -747,7 +890,14 @@ def make_sharded_engine(
             **pv2,
             **obs2,
             **cov_acc,
+            **sp,
         )
+
+    def body(c):
+        # the fused composition: bit-identical to the historical single
+        # fused body (the seam only names intermediates; no collective,
+        # insert or fence moved across it)
+        return commit_half(c, expand_half(c))
 
     def device_loop(c: ShardCarry) -> ShardCarry:
         return lax.while_loop(lambda cc: cc.cont[0], body, c)
@@ -804,7 +954,343 @@ def make_sharded_engine(
             check_vma=False,
         )
     )
+    if _parts is not None:
+        # the ShardedSpillRuntime seam: the two body halves plus the
+        # geometry it needs to jit them as separate shard_map dispatches
+        _parts.update(
+            expand_half=expand_half, commit_half=commit_half,
+            specs=specs, axis=axis, D=D, B=B, ncand=ncand, F=F,
+            n_inv=(0 if deferred else len(backend.inv_codes)),
+            chunk_l=(chunk, L), pipeline=pipeline,
+        )
     return init_fn, run_fn
+
+
+# ---------------- multi-process shard access helpers ---------------------
+# The spill runtime and the jax.distributed pod driver (jaxtlc.dist) both
+# need host access to [D, ...]-sharded carry leaves.  In a single process
+# every row is addressable and np.asarray works; in a pod each process
+# sees only its own rows, and functional updates must go through
+# jax.make_array_from_callback (a collective-style constructor every
+# process calls with its addressable rows).
+
+
+def shard_host_rows(arr) -> dict:
+    """Host copies of the ADDRESSABLE rows of a [D, ...]-sharded array,
+    keyed by global row index (single-process: every row)."""
+    if jax.process_count() == 1:
+        a = np.asarray(arr)
+        return {i: a[i] for i in range(a.shape[0])}
+    out = {}
+    for sh in arr.addressable_shards:
+        start = sh.index[0].start or 0
+        data = np.asarray(sh.data)
+        for k in range(data.shape[0]):
+            out[start + k] = data[k]
+    return out
+
+
+def shard_replace_rows(arr, rows: dict):
+    """Functionally replace rows of a [D, ...]-sharded array from a
+    {global_row: np value} dict; unlisted rows keep their value.  In a
+    pod every process must call this collectively, each passing its OWN
+    addressable rows (make_array_from_callback contract)."""
+    if jax.process_count() == 1:
+        a = np.asarray(arr).copy()
+        for r, v in rows.items():
+            a[r] = v
+        return jnp.asarray(a)
+    local = shard_host_rows(arr)
+    local.update({r: v for r, v in rows.items() if r in local})
+
+    def cb(idx):
+        s = idx[0]
+        stop = s.stop if s.stop is not None else arr.shape[0]
+        return np.stack([local[r] for r in range(s.start or 0, stop)])
+
+    return jax.make_array_from_callback(arr.shape, arr.sharding, cb)
+
+
+def shard_global(mesh: Mesh, arr):
+    """A ["fp"]-sharded global device array from a host-replicated numpy
+    value (every pod process passes the SAME full array and contributes
+    its addressable rows); single-process: a plain device put."""
+    a = np.asarray(arr)
+    if jax.process_count() == 1:
+        return jnp.asarray(a)
+    from jax.sharding import NamedSharding
+
+    (axis,) = mesh.axis_names
+    return jax.make_array_from_callback(
+        a.shape, NamedSharding(mesh, P(axis)), lambda idx: a[idx]
+    )
+
+
+def carry_to_global(mesh: Mesh, carry: ShardCarry) -> ShardCarry:
+    """Lift a host-built ShardCarry (init_fn output, identical on every
+    process) into globally-sharded arrays over `mesh`."""
+    return jax.tree.map(lambda x: shard_global(mesh, x), carry)
+
+
+class ShardedSpillRuntime:
+    """Spill-mode execution of the MESH engine (ISSUE 19, the sharded
+    twin of engine.spill.SpillRuntime): the supervisor swaps its segment
+    function for `segment_fn` when the ladder activates the spill tier
+    on a sharded run, keeping checkpoints/retry/regrow unchanged.
+
+    The runtime drives the engine's own expand/commit halves as two
+    shard_map dispatches with a host probe between them:
+
+        expand + owner fpset_member filter (device, all_to_all inside)
+        -> probable-new readback of THIS HOST's rows ->
+        SpillStore probe (host) -> commit with the host veto (device)
+
+    One SpillStore per process: fingerprint spaces are disjoint across
+    devices (owner = hi & (D-1)), so a single host store is exact for
+    every local device, and in a jax.distributed pod each process's
+    store is precisely the per-host lifeboat - a fingerprint lives in
+    its owner device's table or its owner HOST's store, never both.
+
+    The flush decision is a device-side collective (pmax over per-table
+    occupancy), so every pod process takes the flush on the same chunk
+    step - required, because resetting the global table is a collective
+    array construction.  When ANY device crosses the fp_highwater load,
+    EVERY host flushes all of its local device tables (eager for the
+    under-water ones, but deterministic and exact - the cold tier
+    absorbs everything, like engine.spill's whole-table flush).
+
+    Exactness: a host-vetoed candidate dedups exactly like an owner-
+    table hit, so counters/verdict are bit-for-bit a correctly-sized
+    clean sharded run's (tests/test_shardspill.py pins parity)."""
+
+    def __init__(self, cfg, mesh: Mesh, chunk: int, queue_capacity: int,
+                 fp_capacity: int, fp_index: int = DEFAULT_FP_INDEX,
+                 seed: int = DEFAULT_SEED, route_factor: float = 2.0,
+                 backend: SpecBackend = None, fp_highwater: float = None,
+                 obs_slots: int = 0, sort_free: bool = None,
+                 deferred: bool = None, store=None, on_event=None,
+                 spill_write_hook=None):
+        from .spill import SpillStore
+
+        if backend is None:
+            backend = kubeapi_backend(cfg)
+        if fp_highwater is None:
+            from .bfs import DEFAULT_FP_HIGHWATER
+
+            fp_highwater = DEFAULT_FP_HIGHWATER
+        parts = {}
+        init_fn, _ = make_sharded_engine(
+            cfg, mesh, chunk, queue_capacity, fp_capacity,
+            fp_index=fp_index, seed=seed, route_factor=route_factor,
+            backend=backend, fp_highwater=fp_highwater, pipeline=False,
+            obs_slots=obs_slots, sort_free=sort_free, deferred=deferred,
+            _parts=parts,
+        )
+        self.backend = backend
+        self.mesh = mesh
+        self.chunk = chunk
+        self.fp_capacity = fp_capacity
+        self.fp_highwater = fp_highwater
+        self.store = store if store is not None else SpillStore()
+        self.on_event = on_event
+        # fault seam: called before every host flush (resil.faults
+        # spill_fail@N raises OSError here)
+        self.spill_write_hook = spill_write_hook
+        self.flushes = 0
+        self.probes = 0  # candidates that paid the host round trip
+        self._base_init = init_fn
+        self._D = D = parts["D"]
+        self._DB = DB = D * parts["B"]
+        axis = parts["axis"]
+        self._axis = axis
+        expand_half = parts["expand_half"]
+        commit_half = parts["commit_half"]
+        specs = parts["specs"]._replace(spill_hits=P(axis))
+        self._specs = specs
+        ex_specs = ShardEx(*(P(axis) for _ in ShardEx._fields))
+
+        def _expand_dev(c):
+            ex = expand_half(c, with_member=True)
+            return jax.tree.map(lambda x: x[None], ex)
+
+        def _commit_dev(c, ex, veto):
+            return commit_half(c, jax.tree.map(lambda x: x[0], ex),
+                               veto[0])
+
+        def _res_dev(table):
+            # per-device table occupancy + the collective flush verdict
+            # (measured, not derived from the distinct counter, so a
+            # rolled-back carry whose failed attempt already flushed
+            # entries stays exact - engine.spill's rationale)
+            t = table[0]
+            lo = t[:, 0::2].reshape(-1)
+            hi = t[:, 1::2].reshape(-1)
+            occ = ((lo != 0) | (hi != 0)).sum().astype(jnp.int32)
+            need = occ + DB > int(fp_capacity * fp_highwater)
+            any_need = lax.pmax(need.astype(jnp.int32), axis)
+            return occ[None], any_need[None]
+
+        self._expand_fn = jax.jit(shard_map(
+            _expand_dev, mesh=mesh, in_specs=(specs,),
+            out_specs=ex_specs, check_vma=False,
+        ))
+        self._commit_fn = jax.jit(shard_map(
+            _commit_dev, mesh=mesh, in_specs=(specs, ex_specs, P(axis)),
+            out_specs=specs, check_vma=False,
+        ))
+        self._res_fn = jax.jit(shard_map(
+            _res_dev, mesh=mesh, in_specs=(P(axis),),
+            out_specs=(P(axis), P(axis)), check_vma=False,
+        ))
+        # the preflight self-check's composition: one full device step
+        # with an all-false veto (the host probe happens between the two
+        # jits in production, outside any device body)
+
+        def audit_step(c):
+            ex = self._expand_fn(c)
+            return self._commit_fn(
+                c, ex, jnp.zeros((D, DB), bool)
+            )
+
+        audit_step.donate_requested = False
+        audit_step.donates_carry = False
+        self.audit_step_fn = audit_step
+
+    # -- carries ---------------------------------------------------------
+
+    def init_fn(self):
+        """Fresh spill-mode carry (also the checkpoint template)."""
+        c = self._base_init()
+        if jax.process_count() > 1:
+            c = carry_to_global(self.mesh, c)
+        return self.adopt(c)
+
+    def adopt(self, carry: ShardCarry) -> ShardCarry:
+        """Enter spill mode: add the spill_hits leaf (idempotent).  The
+        saturated device tables stay put - the first chunk's residency
+        collective flushes them to the host store."""
+        assert carry.pv_n is None, \
+            "spill mode runs unpipelined sharded carries only"
+        if carry.spill_hits is None:
+            carry = carry._replace(
+                spill_hits=shard_global(
+                    self.mesh, np.zeros(self._D, np.uint32)
+                )
+            )
+        return carry
+
+    def _emit(self, kind: str, **info) -> None:
+        if self.on_event is not None:
+            self.on_event(kind, info)
+
+    # -- host readbacks (replicated scalars: any addressable row works) --
+
+    def _cont(self, carry) -> bool:
+        return bool(np.any([v for v in
+                            shard_host_rows(carry.cont).values()]))
+
+    def _viol(self, carry) -> int:
+        return int(max(int(v) for v in
+                       shard_host_rows(carry.viol).values()))
+
+    def _hits(self, carry) -> int:
+        return int(sum(int(v) for v in
+                       shard_host_rows(carry.spill_hits).values()))
+
+    # -- the host-driven step loop --------------------------------------
+
+    def _flush(self, carry: ShardCarry) -> ShardCarry:
+        """Migrate every LOCAL device table into this host's store and
+        reset the global table (all processes flush on the same chunk
+        step - the residency verdict is a pmax).  Raises OSError through
+        spill_write_hook under fault injection."""
+        try:
+            if self.spill_write_hook is not None:
+                self.spill_write_hook()
+        except OSError as e:
+            from .spill import SpillWriteError
+
+            raise SpillWriteError(str(e)) from e
+        from .fpset import unmix_host
+
+        rows = shard_host_rows(carry.table)
+        zeroed = {}
+        for d, t in rows.items():
+            lo = t[:, 0::2].reshape(-1)
+            hi = t[:, 1::2].reshape(-1)
+            occ = (lo != 0) | (hi != 0)
+            raw_lo, raw_hi = unmix_host(lo[occ], hi[occ])
+            self.store.insert_batch(raw_lo, raw_hi)
+            zeroed[d] = np.zeros_like(t)
+        self.flushes += 1
+        carry = carry._replace(
+            table=shard_replace_rows(carry.table, zeroed)
+        )
+        self._emit(
+            "spill", phase="flush", resident=0,
+            spilled=self.store.count, capacity=self.store.capacity,
+            hits=self._hits(carry), probes=self.probes,
+        )
+        return carry
+
+    def _veto_array(self, rows: dict):
+        if jax.process_count() == 1:
+            a = np.zeros((self._D, self._DB), bool)
+            for r, v in rows.items():
+                a[r] = v
+            return jnp.asarray(a)
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, P(self._axis))
+
+        def cb(idx):
+            s = idx[0]
+            stop = s.stop if s.stop is not None else self._D
+            return np.stack([rows[r] for r in range(s.start or 0, stop)])
+
+        return jax.make_array_from_callback(
+            (self._D, self._DB), sharding, cb
+        )
+
+    def segment_fn(self, ckpt_every: int):
+        """seg_fn(carry) -> carry after up to `ckpt_every` chunk steps
+        (synchronous - the host sits in the loop; the supervisor's
+        block_until_ready at the fence is then a no-op).  Chunk steps
+        and their pop sequence match the fused sharded body's, so
+        bit-for-bit parity with a clean run holds."""
+
+        def seg(carry):
+            for _ in range(ckpt_every):
+                if not self._cont(carry):
+                    break
+                _occ, need = self._res_fn(carry.table)
+                if max(int(v) for v in
+                       shard_host_rows(need).values()):
+                    carry = self._flush(carry)
+                ex = self._expand_fn(carry)
+                lo_rows = shard_host_rows(ex.r_lo)
+                hi_rows = shard_host_rows(ex.r_hi)
+                va_rows = shard_host_rows(ex.r_valid)
+                mb_rows = shard_host_rows(ex.member)
+                veto_rows = {}
+                for d in lo_rows:
+                    probable = va_rows[d] & ~mb_rows[d]
+                    veto = np.zeros(self._DB, bool)
+                    npn = int(probable.sum())
+                    if npn:
+                        self.probes += npn
+                        veto[probable] = self.store.probe(
+                            lo_rows[d][probable], hi_rows[d][probable]
+                        )
+                    veto_rows[d] = veto
+                carry = self._commit_fn(
+                    carry, ex, self._veto_array(veto_rows)
+                )
+                if self._viol(carry) != OK:
+                    break
+            return carry
+
+        return seg
 
 
 def result_from_shard_carry(

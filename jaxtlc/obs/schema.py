@@ -145,6 +145,15 @@ EVENTS = {
     "reduce": {"states_pruned": _NUM, "ample_hit_rate": _NUM,
                "orbit_factor": _NUM, "generated": _NUM,
                "distinct": _NUM},
+    # -- multi-host pods (jaxtlc.dist, ISSUE 19) ---------------------------
+    # host membership + per-host shard telemetry on the writing HOST's
+    # journal: phase in ("join", "leave", "reshard", "stats"); host =
+    # the jax process index, hosts = pod width at the event.  "stats"
+    # rows carry the per-host gauges obs.views surfaces as
+    # jaxtlc_host_* (extra fields: shard_occupancy, spill_bytes,
+    # exchange_us); "leave" rows carry the checkpoint path; "reshard"
+    # rows carry old_hosts/new_hosts
+    "pod": {"phase": _STR, "host": _NUM, "hosts": _NUM},
     # -- serve-plane scheduling (serve.scheduler, ISSUE 17) ----------------
     # one per scheduler decision, written to the scheduler's own
     # journal (root/sched.journal.jsonl): action in ("admit", "reject",

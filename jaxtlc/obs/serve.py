@@ -20,7 +20,11 @@ server over a journal file or a directory of them, serving
 * ``/runs`` - the run registry: every ``*.journal.jsonl`` under the
   root, with workload/engine/verdict summary - many concurrent runs
   multiplex through one server (``?run=NAME`` selects on the other
-  endpoints);
+  endpoints).  A multi-host pod's per-host journals
+  (``{base}.h{pid}.journal.jsonl``, jaxtlc.dist) are GROUPED into one
+  registry row (``run={base}``, ``pod_hosts=N``); selecting that row
+  serves the N journals merged into one time-ordered stream on
+  /metrics /journal /events, so a pod reads like a single run;
 * ``/journal`` - the raw JSONL (tools/tlcstat.py --connect renders its
   dashboard from this, a remote client of the same views).
 
@@ -36,6 +40,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 import urllib.parse
@@ -43,7 +48,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from . import journal as jr
-from .views import metrics_from_events
+from .views import merge_journals, metrics_from_events
 
 JOURNAL_SUFFIX = ".journal.jsonl"
 POLL_S = 0.2
@@ -96,11 +101,55 @@ def _run_row(p: str) -> Optional[dict]:
     return row
 
 
+# per-host pod journal names: {base}.h{pid}.journal.jsonl (jaxtlc.dist)
+_POD_HOST_RE = re.compile(r"^(?P<base>.+)\.h(?P<host>\d+)$")
+
+# worst verdict wins when a pod's hosts disagree (one host's violation
+# outranks the others' ok; a still-running host outranks finished ok)
+_VERDICT_RANK = {"ok": 0, "running": 1, "interrupted": 2,
+                 "exhausted": 3, "error": 4, "violation": 5}
+
+
+def _group_pod_rows(rows: List[dict]) -> List[dict]:
+    """Collapse per-host pod journal rows into one row per pod run.
+
+    Hosts of the same run share everything but their shard, so the
+    merged row sums events/resumes, takes the newest last_t, and keeps
+    the worst verdict; `paths` (host order) lets the other endpoints
+    serve the journals merged into one stream."""
+    out, pods = [], {}
+    for r in rows:
+        m = _POD_HOST_RE.match(r["run"])
+        if m:
+            pods.setdefault(m.group("base"), []).append(
+                (int(m.group("host")), r))
+        else:
+            out.append(r)
+    for base, members in pods.items():
+        members.sort()
+        hrows = [r for _, r in members]
+        out.append({
+            "run": base,
+            "path": hrows[0]["path"],
+            "paths": [r["path"] for r in hrows],
+            "pod_hosts": len(hrows),
+            "events": sum(r["events"] for r in hrows),
+            "workload": hrows[0]["workload"],
+            "engine": hrows[0]["engine"],
+            "verdict": max((r["verdict"] for r in hrows),
+                           key=lambda v: _VERDICT_RANK.get(v, 4)),
+            "last_t": max((r["last_t"] or 0 for r in hrows)) or None,
+            "resumes": sum(r["resumes"] for r in hrows),
+        })
+    return out
+
+
 def _runs(root: str) -> List[dict]:
     """The run registry: one row per journal under `root` (or the row
     of `root` itself when it IS a journal file), newest first.  Scans
     are cached by (path, mtime, size) - unchanged journals cost one
-    stat per request, not a full re-read."""
+    stat per request, not a full re-read.  Per-host pod journals are
+    grouped into one row per pod (_group_pod_rows)."""
     paths = []
     if os.path.isdir(root):
         for name in sorted(os.listdir(root)):
@@ -114,8 +163,18 @@ def _runs(root: str) -> List[dict]:
             if os.path.dirname(stale) == (root if os.path.isdir(root)
                                           else os.path.dirname(root)):
                 _RUNS_CACHE.pop(stale, None)
+    rows = _group_pod_rows(rows)
     rows.sort(key=lambda r: r["last_t"] or 0, reverse=True)
     return rows
+
+
+def _row_events(row: dict) -> List[dict]:
+    """Read a registry row's events - one journal, or a pod's per-host
+    journals k-way merged into one time-ordered stream."""
+    paths = row.get("paths") or [row["path"]]
+    if len(paths) == 1:
+        return jr.read(paths[0], validate=False)
+    return merge_journals(*(jr.read(p, validate=False) for p in paths))
 
 
 def prometheus_text(metrics: dict) -> str:
@@ -140,6 +199,17 @@ def prometheus_text(metrics: dict) -> str:
                     f'jaxtlc_phase_wall_seconds{{phase="{phase}"}} '
                     f"{secs}"
                 )
+            continue
+        if key == "pod_hosts":
+            # per-host pod gauges (jaxtlc.dist): shard-table load,
+            # spill-store bytes, level-fence exchange wall
+            lines.append("# HELP jaxtlc_host_shard_occupancy per-host "
+                         "fingerprint-table load fraction")
+            for host, gauges in sorted(val.items()):
+                for gk, gv in sorted(gauges.items()):
+                    lines.append(
+                        f'jaxtlc_host_{gk}{{host="{host}"}} {gv}'
+                    )
             continue
         if key == "coverage_sites":
             # the device coverage plane's per-site counters (ISSUE 11)
@@ -218,16 +288,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _journal_path(self, qs: dict) -> Optional[str]:
+    def _journal_row(self, qs: dict) -> Optional[dict]:
         """Resolve ?run=NAME against the registry (default: the most
-        recently appended journal)."""
+        recently appended run).  A pod row carries `paths` - all its
+        per-host journals; NAME matches the pod base or any member."""
         rows = _runs(self.root)
         want = qs.get("run", [None])[0]
         if want is None:
-            return rows[0]["path"] if rows else None
+            return rows[0] if rows else None
         for r in rows:
-            if r["run"] == want or r["path"] == want:
-                return r["path"]
+            if (r["run"] == want or r["path"] == want
+                    or want in r.get("paths", ())):
+                return r
         return None
 
     # -- endpoints -------------------------------------------------------
@@ -242,22 +314,22 @@ class _Handler(BaseHTTPRequestHandler):
                     {"runs": _runs(self.root)}
                 ).encode(), "application/json")
             elif route == "/metrics":
-                path = self._journal_path(qs)
-                if path is None:
+                row = self._journal_row(qs)
+                if row is None:
                     self._send(404, b"no journal\n", "text/plain")
                     return
-                events = jr.read(path, validate=False)
+                events = _row_events(row)
                 self._send(
                     200,
                     prometheus_text(metrics_from_events(events)).encode(),
                     "text/plain; version=0.0.4",
                 )
             elif route == "/journal":
-                path = self._journal_path(qs)
-                if path is None:
+                row = self._journal_row(qs)
+                if row is None:
                     self._send(404, b"no journal\n", "text/plain")
                     return
-                events = jr.read(path, validate=False)
+                events = _row_events(row)
                 body = "".join(
                     json.dumps(e, sort_keys=True) + "\n" for e in events
                 ).encode()
@@ -266,13 +338,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # live device coverage: cumulative per-site totals,
                 # derived from the journal's `coverage` delta events
                 # (the same fold the Prometheus counters render)
-                path = self._journal_path(qs)
-                if path is None:
+                row = self._journal_row(qs)
+                if row is None:
                     self._send(404, b"no journal\n", "text/plain")
                     return
                 from .coverage import coverage_from_events
 
-                events = jr.read(path, validate=False)
+                events = _row_events(row)
                 cov = coverage_from_events(events)
                 if cov is None:
                     self._send(404, b"run has no coverage plane\n",
@@ -325,9 +397,12 @@ class _Handler(BaseHTTPRequestHandler):
         subscriber never sees a partial event (and never sees it
         twice).  The stream survives the writer's interrupt+`-recover`
         because resume APPENDS to the same file - one continuous
-        stream per logical run."""
-        path = self._journal_path(qs)
-        if path is None:
+        stream per logical run.  A pod run tails EVERY per-host journal
+        and merges each tick's batch by timestamp - one stream for the
+        whole pod (cross-tick ordering is arrival order, the same
+        best-effort a scrape of live files can ever give)."""
+        row = self._journal_row(qs)
+        if row is None:
             self._send(404, b"no journal\n", "text/plain")
             return
         once = qs.get("once", ["0"])[0] not in ("0", "")
@@ -338,11 +413,13 @@ class _Handler(BaseHTTPRequestHandler):
         # SSE is an unbounded stream: no Content-Length, close delimits
         self.send_header("Connection", "close")
         self.end_headers()
-        tail = _JournalTail(path)
+        tails = [_JournalTail(p)
+                 for p in (row.get("paths") or [row["path"]])]
         emitted = 0
         while not self.server._jaxtlc_shutdown.is_set():
+            batch = merge_journals(*(t.poll() for t in tails))
             wrote = False
-            for ev in tail.poll():
+            for ev in batch:
                 emitted += 1
                 if emitted <= skip:
                     continue

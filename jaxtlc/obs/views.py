@@ -25,6 +25,35 @@ from typing import Optional, Tuple
 from .journal import RunJournal
 
 
+def merge_journals(*streams):
+    """Fold per-host pod journals (jaxtlc.dist writes one
+    ``{base}.h{pid}.journal.jsonl`` per process) into ONE time-ordered
+    event stream.  Each journal is append-ordered by its own `t`
+    stamps, so this is a k-way sorted merge; ties keep input order
+    (host-major), preserving every host's internal event order.  The
+    serve plane's /runs registry uses it to present a pod as one
+    logical run."""
+    import heapq
+
+    return list(heapq.merge(*streams, key=lambda e: e.get("t", 0)))
+
+
+def pod_host_gauges(events) -> Optional[dict]:
+    """The per-host gauge table from a (merged) journal's ``pod``
+    events: {host: {shard_occupancy, spill_bytes, exchange_us}}, each
+    host's LATEST stats row winning (the rows arrive at segment fences).
+    None when the journal carries no pod plane."""
+    hosts = {}
+    for e in events:
+        if e.get("event") == "pod" and e.get("phase") == "stats":
+            hosts[int(e["host"])] = {
+                "shard_occupancy": e.get("shard_occupancy", 0),
+                "spill_bytes": e.get("spill_bytes", 0),
+                "exchange_us": e.get("exchange_us", 0),
+            }
+    return hosts or None
+
+
 def interval_rates(prev: Optional[Tuple[float, int, int]],
                    now: float, generated: int,
                    distinct: int) -> Tuple[int, int]:
@@ -178,6 +207,25 @@ def metrics_from_events(events) -> dict:
                       if "queued" in e), None)
         if depth is not None:
             out["sched_queue_depth"] = depth
+    pod_evs = [e for e in events if e["event"] == "pod"]
+    if pod_evs:
+        # multi-host pods (ISSUE 19): membership counters + the
+        # per-host shard gauges (Prometheus jaxtlc_host_* with a host
+        # label - shard table load, spill-store bytes, and the
+        # level-fence exchange/consensus wall in µs)
+        out["pod_size"] = max(int(e["hosts"]) for e in pod_evs)
+        out["pod_joins_total"] = sum(
+            1 for e in pod_evs if e.get("phase") == "join")
+        leaves = sum(1 for e in pod_evs if e.get("phase") == "leave")
+        reshards = sum(
+            1 for e in pod_evs if e.get("phase") == "reshard")
+        if leaves:
+            out["pod_leaves_total"] = leaves
+        if reshards:
+            out["pod_reshards_total"] = reshards
+        hosts = pod_host_gauges(pod_evs)
+        if hosts:
+            out["pod_hosts"] = hosts
     sp = next((e for e in reversed(events) if e["event"] == "spill"),
               None)
     if sp is not None:

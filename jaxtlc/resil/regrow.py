@@ -307,6 +307,12 @@ def migrate_shard_carry(
         pv["cov_counts"] = jnp.asarray(
             np.asarray(carry.cov_counts), jnp.uint32
         )
+    if getattr(carry, "spill_hits", None) is not None:
+        # sharded spill-mode hit partials: telemetry, travels verbatim
+        # (the host store rolls back via SpillStore.snapshot/restore)
+        pv["spill_hits"] = jnp.asarray(
+            np.asarray(carry.spill_hits), jnp.uint32
+        )
     return ShardCarry(
         table=jnp.asarray(table2),
         queue=jnp.asarray(queue2),

@@ -615,7 +615,27 @@ class ShardedAdapter:
     def supports_spill(self) -> bool:
         from ..engine.sharded import SPILL_CAPABLE
 
-        return SPILL_CAPABLE
+        # like the single-device adapter: the spill driver runs the
+        # unpipelined halves; a pipelined carry's pending-verdict block
+        # has no spill composition (ladder degrades to the next rung)
+        return SPILL_CAPABLE and not self.pipeline
+
+    def build_spill(self, params: dict, store, on_event=None,
+                    spill_write_hook=None):
+        """A ShardedSpillRuntime over this adapter's backend + geometry
+        (the supervisor swaps its segment function for the runtime's
+        when the ladder activates the host tier on a sharded run)."""
+        from ..engine.sharded import ShardedSpillRuntime
+
+        return ShardedSpillRuntime(
+            self.cfg, self.mesh, self.chunk,
+            params["queue_capacity"], params["fp_capacity"],
+            route_factor=params["route_factor"], backend=self.backend,
+            fp_highwater=self.fp_highwater, obs_slots=self.obs_slots,
+            sort_free=self.sort_free, deferred=self.deferred,
+            store=store, on_event=on_event,
+            spill_write_hook=spill_write_hook,
+        )
 
     def migrate(self, carry, old_params: dict, new_params: dict):
         return migrate_shard_carry(carry, old_params, new_params)
@@ -1266,7 +1286,9 @@ def supervise(adapter, params: dict,
     spill_hits = 0
     if spill_rt is not None and getattr(carry, "spill_hits",
                                         None) is not None:
-        spill_hits = int(np.asarray(carry.spill_hits))
+        # scalar on the single-device carry, [D] partials on the
+        # sharded carry - sum covers both
+        spill_hits = int(np.asarray(carry.spill_hits).sum())
     return SupervisedResult(
         result=result,
         params=params,

@@ -542,8 +542,9 @@ def test_selfcheck_registry_pinned():
 
     assert sorted(FACTORIES) == [
         "covered", "deferred", "enumerator", "fused", "infer",
-        "narrowed", "phased", "pipelined", "por", "sharded", "sim",
-        "sortfree", "spill", "struct", "sweep", "symmetry",
+        "narrowed", "phased", "pipelined", "por", "sharded",
+        "shardspill", "sim", "sortfree", "spill", "struct", "sweep",
+        "symmetry",
     ]
 
 

@@ -27,8 +27,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # the registry is jax-free, so this stays an engine-free gate
 REQUIRED_FACTORIES = (
     "covered", "deferred", "enumerator", "fused", "infer",
-    "narrowed", "phased", "pipelined", "por", "sharded", "sim",
-    "sortfree", "spill", "struct", "sweep", "symmetry",
+    "narrowed", "phased", "pipelined", "por", "sharded",
+    "shardspill", "sim", "sortfree", "spill", "struct", "sweep",
+    "symmetry",
 )
 
 

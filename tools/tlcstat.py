@@ -119,6 +119,28 @@ def render(events) -> str:
         f"  interruptions {counts.get('interrupted', 0)}"
         f"  degrades {counts.get('degrade', 0)}"
     )
+    # multi-host pod (jaxtlc.dist): per-host shard-table load + spill
+    # bytes from the latest pod stats row of each host, and the fence
+    # exchange wall of the slowest host (the fence waits for it)
+    from jaxtlc.obs.views import pod_host_gauges
+
+    pod = pod_host_gauges(events)
+    if pod is not None:
+        hosts = max(e["hosts"] for e in events if e["event"] == "pod")
+        per = "  ".join(
+            f"h{h} shard {g['shard_occupancy']:.1%}"
+            + (f" spill {g['spill_bytes'] / 1024:.0f}KiB"
+               if g["spill_bytes"] else "")
+            for h, g in sorted(pod.items())
+        )
+        fence = max(g["exchange_us"] for g in pod.values())
+        reshards = sum(1 for e in events if e["event"] == "pod"
+                       and e.get("phase") == "reshard")
+        lines.append(
+            f"pod: {hosts} hosts  |  {per}  |  fence "
+            f"{fence / 1000:.1f}ms"
+            + (f"  |  reshards {reshards}" if reshards else "")
+        )
     # host spill tier: occupancy + hit rate of the most recent spill
     # event (the device tier's cold-fingerprint overflow store)
     sp = next((e for e in reversed(events) if e["event"] == "spill"),
