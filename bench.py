@@ -1560,9 +1560,160 @@ def bench_multihost_ab(probe_err: str) -> int:
     return 0
 
 
+def bench_pod_obs_ab(probe_err: str) -> int:
+    """--pod-obs-ab: the obs plane must be free ON A POD, bit-for-bit.
+
+    Runs the same 2-process x 2-device loopback pod (gloo collectives,
+    KubeAPI FF workload) twice - obs OFF vs obs ON (counter ring 256 +
+    the workload CoveragePlane, per-host journals) - and gates the ON
+    run bit-for-bit against OFF: the full result signature (counts,
+    per-action counters, outdegree, occupancy from POD_RESULT) AND the
+    fpset TABLE words of every host's final shard checkpoint - the
+    PR 5/11 telemetry-not-a-participant gate, now across process
+    boundaries.  The merged {base}.hN sibling journals must also fold
+    back to the engine's own totals: the last pod-global level row
+    carries the exact generated/distinct counts and the summed site
+    table reproduces every action's generated counter site-for-site.
+    Emits `pod_obs_overhead_pct`; like --cov-ab, the wall number is
+    reported honestly but only gates on-chip (the CPU backend pays
+    per-op dispatch for the site hook - the standing PERF.md caveat)."""
+    import json as _json
+    import os
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    expect = (17020, 8203, 109)  # KubeAPI FF oracle (BASELINE.md)
+    procs, dph = 2, 2
+
+    def _pod(obs: bool, ckpt: str, timeout_s: int = 600) -> dict:
+        cmd = [sys.executable, "-m", "jaxtlc.dist",
+               "--spawn", str(procs), "--devices-per-host", str(dph),
+               "--ff", "--chunk", "128", "--queue-capacity", "4096",
+               "--fp-capacity", "16384", "--ckpt", ckpt]
+        if obs:
+            cmd += ["--obs-slots", "256", "--coverage"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        try:
+            proc = subprocess.run(
+                cmd, env=env, timeout=timeout_s, capture_output=True,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            return {"error": f"pod timed out > {timeout_s}s"}
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("POD_RESULT ")), None)
+        if proc.returncode != 0 or line is None:
+            tail = (proc.stdout + proc.stderr).strip().splitlines()[-3:]
+            return {"error": f"rc={proc.returncode} {tail}"}
+        return _json.loads(line[len("POD_RESULT "):])
+
+    from jaxtlc.dist.pod import (
+        _load_host_payload, host_checkpoint_path, host_journal_path,
+    )
+
+    runs = {}
+    tables = {}
+    jpaths = []
+    with tempfile.TemporaryDirectory() as d:
+        for obs in (False, True):
+            ck = os.path.join(d, f"obs_{'on' if obs else 'off'}.ckpt")
+            r = _pod(obs, ck)
+            counts = (r.get("generated"), r.get("distinct"),
+                      r.get("depth"))
+            if "error" in r or r.get("rc") != 0 or counts != expect:
+                _emit({"error": f"obs={obs} pod failed: "
+                                f"{r.get('error', counts)}",
+                       "workload": "kubeapi_ff_pod"})
+                return 1
+            runs[obs] = r
+            # final per-host shard checkpoints hold the end-of-run
+            # carry (save_all runs at the last fence) - the TABLE words
+            tables[obs] = []
+            for h in range(procs):
+                _, payload = _load_host_payload(
+                    host_checkpoint_path(ck, h))
+                tables[obs].append(payload["table"])
+            if obs:
+                jpaths = [host_journal_path(ck, h)
+                          for h in range(procs)]
+
+        def signature(r):
+            return (r["generated"], r["distinct"], r["depth"],
+                    r["violation"],
+                    tuple(sorted(r["action_generated"].items())),
+                    tuple(sorted(r["action_distinct"].items())),
+                    r["outdegree"], r["fp_occupancy"])
+
+        if signature(runs[False]) != signature(runs[True]):
+            _emit({"error": "obs-on pod result signature differs "
+                            "from obs-off",
+                   "workload": "kubeapi_ff_pod"})
+            return 1
+        for h, (off, on) in enumerate(zip(tables[False],
+                                          tables[True])):
+            if not np.array_equal(off, on):
+                _emit({"error": f"host {h} fpset TABLE words differ "
+                                "between obs-on and obs-off pods",
+                       "workload": "kubeapi_ff_pod"})
+                return 1
+
+        # the merge tier: sibling journals -> ONE pod-global timeline
+        from jaxtlc.obs import journal as _jr
+        from jaxtlc.obs.coverage import coverage_from_events
+        from jaxtlc.obs.views import fold_pod_levels, merge_journals
+
+        events = merge_journals(*(
+            _jr.read(p, validate=False) for p in jpaths))
+        levels = [e for e in fold_pod_levels(events)
+                  if e.get("event") == "level"]
+        cov = coverage_from_events(events)
+        if not levels or cov is None:
+            _emit({"error": "obs-on pod journals carry no level / "
+                            "coverage events",
+                   "workload": "kubeapi_ff_pod"})
+            return 1
+        last = levels[-1]
+        if (last["generated"], last["distinct"],
+                last["level"]) != expect:
+            _emit({"error": "folded pod level rows do not reach the "
+                            f"engine totals: {last}",
+                   "workload": "kubeapi_ff_pod"})
+            return 1
+        for name, g in runs[True]["action_generated"].items():
+            if cov["sites"].get(name, 0) != g:
+                _emit({"error": f"merged pod coverage site {name} "
+                                f"{cov['sites'].get(name, 0)} != "
+                                f"generated {g}",
+                       "workload": "kubeapi_ff_pod"})
+                return 1
+
+    wall_off, wall_on = runs[False]["wall_s"], runs[True]["wall_s"]
+    overhead_pct = round((wall_on - wall_off) / wall_off * 100, 3)
+    _emit({
+        "metric": "pod_obs_overhead_pct",
+        "value": overhead_pct,
+        "unit": "%",
+        "workload": "kubeapi_ff_pod",
+        "procs": procs,
+        "devices_per_host": dph,
+        "wall_s_off": wall_off,
+        "wall_s_on": wall_on,
+        "pod_levels": len(levels),
+        "pod_sites_visited": cov["visited"],
+        "bit_identical": True,
+        "device": "cpu pod (gloo loopback)",
+    })
+    return 0
+
+
 def main() -> int:
     device_note = ""
     probe_err = _probe_backend()
+    if "--pod-obs-ab" in sys.argv:
+        return bench_pod_obs_ab(probe_err)
     if "--multihost-ab" in sys.argv:
         return bench_multihost_ab(probe_err)
     if "--infer" in sys.argv:

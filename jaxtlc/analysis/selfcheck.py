@@ -144,6 +144,30 @@ def _build_covered():
                 n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
 
 
+def _build_covsharded():
+    # the pod obs MESH engine (ISSUE 20): the sharded owner-commit
+    # engine with the counter ring + coverage plane riding its carry -
+    # the per-shard cov_counts leaf and ring rows the pod driver
+    # checkpoints, reads at fences and migrates on --reshard cannot
+    # ship unaudited
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..config import ModelConfig
+    from ..engine.backend import kubeapi_backend
+    from ..engine.sharded import make_sharded_engine
+
+    b = kubeapi_backend(ModelConfig(False, False), coverage=True)
+    assert b.coverage is not None, "covsharded factory needs a plane"
+    mesh = Mesh(np.array(jax.devices()[:1]), ("fp",))
+    init_fn, run_fn = make_sharded_engine(
+        None, mesh, backend=b, obs_slots=8, **_TINY
+    )
+    return dict(init_fn=init_fn, run_fn=run_fn,
+                n_lanes=b.n_lanes, fp_capacity=_TINY["fp_capacity"])
+
+
 def _build_sortfree():
     # the hash-slab commit engine (ISSUE 12): the same TwoPhase model
     # as "struct" but committed through the sort-free dedup, with the
@@ -476,6 +500,7 @@ def _build_phased():
 # by tier-1 so a new engine path cannot ship unaudited
 FACTORIES: Dict[str, Callable[[], dict]] = {
     "covered": _build_covered,
+    "covsharded": _build_covsharded,
     "deferred": _build_deferred,
     "fused": _build_fused,
     "infer": _build_infer,

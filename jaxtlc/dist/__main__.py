@@ -65,6 +65,12 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--route-factor", type=float, default=2.0)
     p.add_argument("--sort-free", choices=tuple(_TRI), default="auto")
     p.add_argument("--deferred", choices=tuple(_TRI), default="auto")
+    p.add_argument("--obs-slots", type=int, default=0,
+                   help="device counter-ring slots (per-host `level` "
+                        "events with a host field; 0 = off)")
+    p.add_argument("--coverage", action="store_true",
+                   help="attach the workload's CoveragePlane (per-host "
+                        "`coverage` delta events)")
     p.add_argument("--ckpt", default=None,
                    help="checkpoint/journal base path (per-host files "
                         "{base}.h{pid} / {base}.h{pid}.journal.jsonl)")
@@ -105,6 +111,8 @@ def _worker(args) -> int:
         route_factor=args.route_factor,
         sort_free=_TRI[args.sort_free],
         deferred=_TRI[args.deferred],
+        obs_slots=args.obs_slots,
+        coverage=args.coverage,
         ckpt_path=args.ckpt,
         ckpt_every=args.ckpt_every,
         resume=args.resume,
@@ -119,6 +127,12 @@ def _worker(args) -> int:
         host=pr.host, hosts=pr.hosts, rc=pr.exit_code,
         generated=r.generated, distinct=r.distinct, depth=r.depth,
         queue=r.queue_left, violation=r.violation,
+        outdegree=[round(float(v), 6) for v in r.outdegree],
+        fp_occupancy=round(float(r.fp_occupancy), 6),
+        action_generated={k: int(v)
+                          for k, v in r.action_generated.items()},
+        action_distinct={k: int(v)
+                         for k, v in r.action_distinct.items()},
         wall_s=round(r.wall_s, 3), segments=pr.segments,
         resumed=pr.resumed, resharded=pr.resharded,
         spilled=pr.spilled, spill_flushes=pr.spill_flushes,

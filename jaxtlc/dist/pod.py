@@ -33,9 +33,19 @@ protocol around that body:
   per device (engine/sharded.py).  Spill + reshard is unsupported (the
   host stores are keyed per-host); resume at the original width.
 
-Residue (ROADMAP #1): pods run obs_slots=0 (the per-level ring is
-replaced by journaled ``progress``/``pod`` rows at segment fences), and
-site coverage is not reported in pod mode.
+* **Pod-native observability** (ISSUE 20, closing ROADMAP #1 residue
+  (a)): ``obs_slots``/``coverage`` thread the PR 5 counter ring and the
+  PR 11 CoveragePlane through the sharded engine, so each host's carry
+  holds its own ring + ``cov_counts`` rows (checkpointed with the shard,
+  migrated on ``--reshard``).  At every segment fence the driver decodes
+  only its ADDRESSABLE ring rows into per-host PARTIAL ``level`` events
+  and its local ``cov_counts`` rows into per-host ``coverage`` deltas -
+  each tagged with a ``host`` field - plus a ``segment`` timing event,
+  so obs.views.fold_pod_levels / obs.coverage can re-sum the sibling
+  journals into pod-global counters and obs.trace can render one
+  timeline with a process row per host, lanes aligned on the fence
+  timestamps.  Pure telemetry: obs-on pod runs are bit-for-bit obs-off
+  runs (bench.py --pod-obs-ab gates signature + fpset TABLE words).
 """
 
 from __future__ import annotations
@@ -59,6 +69,10 @@ EXIT_VIOLATION = 12  # TLC ExitStatus safety-violation (cli contract)
 EXIT_PREEMPTED = 75  # EX_TEMPFAIL: shard checkpointed, relaunch to resume
 
 DEFAULT_COORDINATOR = "127.0.0.1:12731"
+
+# levels with no new site before the once-per-run saturation event
+# fires (the supervisor's coverage_sat_levels default, PR 11)
+COVERAGE_SAT_LEVELS = 8
 
 # engine keys a pod resume must always match (mirrors
 # check_sharded_with_checkpoints; "spill" shapes the carry leaves)
@@ -407,11 +421,11 @@ def reshard_carry(carry, backend, d_new: int,
     seed = DEFAULT_SEED if seed is None else seed
     if d_new & (d_new - 1):
         raise ValueError(f"pod width must be a power of two, got {d_new}")
-    for f in ("pv_n", "obs_ring", "spill_hits"):
+    for f in ("pv_n", "spill_hits"):
         if getattr(carry, f, None) is not None:
             raise ValueError(
                 f"reshard does not support carries with {f} (pipelined/"
-                "obs/spill pod snapshots resume at their own width)"
+                "spill pod snapshots resume at their own width)"
             )
     table = np.asarray(carry.table)
     queue = np.asarray(carry.queue)
@@ -502,6 +516,27 @@ def reshard_carry(carry, backend, d_new: int,
     extra = {}
     if getattr(carry, "cov_counts", None) is not None:
         extra["cov_counts"] = row0(carry.cov_counts)
+    if getattr(carry, "obs_ring", None) is not None:
+        # the ring's per-level rows are attributions of PAST partials -
+        # like the row-0 counters above they are bookkeeping, not state;
+        # the new width starts a fresh ring.  Only the STICKY flags must
+        # survive: sticky_overflow reads the max over the WHOLE ring
+        # (dump row included), so writing the old pod's flag maxima
+        # into every new dump row keeps overflow/cert/sym sticky across
+        # the reshard.  Heads replicate the old minimum so the resumed
+        # driver's decode cursor (restored local min head) sees no
+        # phantom rows in the zeroed region.
+        from ..obs.counters import COL_CERT, COL_OVERFLOW, COL_SYM
+
+        ring = np.asarray(carry.obs_ring)
+        ring2 = np.zeros((d_new,) + ring.shape[1:], ring.dtype)
+        for col in (COL_OVERFLOW, COL_CERT, COL_SYM):
+            ring2[:, -1, col] = ring[:, :, col].max()
+        heads = np.asarray(carry.obs_head)
+        extra["obs_ring"] = ring2
+        extra["obs_head"] = np.full(d_new, heads.min(), heads.dtype)
+        extra["obs_bodies"] = row0(carry.obs_bodies)
+        extra["obs_expanded"] = row0(carry.obs_expanded)
     return ShardCarry(
         table=table2,
         queue=queue2,
@@ -553,6 +588,8 @@ def run_pod(
     route_factor: float = 2.0,
     sort_free: bool = None,
     deferred: bool = None,
+    obs_slots: int = 0,
+    coverage: bool = False,
     ckpt_path: str = None,
     ckpt_every: int = 64,
     resume: bool = False,
@@ -576,17 +613,27 @@ def run_pod(
     chunk/queue_capacity/fp_capacity are PER DEVICE, exactly the
     sharded-engine contract - a pod of H hosts multiplies total table
     capacity by H at constant per-host memory, which is the scaling
-    claim bench.py --multihost-ab commits."""
+    claim bench.py --multihost-ab commits.
+
+    obs_slots > 0 turns the device counter ring on (per-host PARTIAL
+    `level` events with a `host` field, decoded from this process's
+    ring rows at each fence); coverage=True attaches the workload's
+    CoveragePlane (per-host `coverage` delta events).  Both are pure
+    telemetry - obs-on results are bit-for-bit obs-off results
+    (bench.py --pod-obs-ab)."""
     import jax
 
     from ..engine.bfs import resolve_deferred, resolve_sort_free
     from ..engine.checkpoint import _meta, read_checkpoint_meta
     from ..engine.fingerprint import DEFAULT_FP_INDEX, DEFAULT_SEED
     from ..engine.sharded import (
-        carry_to_global, kubeapi_backend, make_sharded_engine,
+        carry_to_global, cov_totals_local, kubeapi_backend,
+        make_sharded_engine, obs_rows_sharded_local,
         result_from_shard_carry, shard_host_rows, shard_replace_rows,
         ShardedSpillRuntime,
     )
+    from ..obs.coverage import coverage_delta_event
+    from ..obs.phases import segment_phases
 
     fp_index = DEFAULT_FP_INDEX if fp_index is None else fp_index
     seed = DEFAULT_SEED if seed is None else seed
@@ -599,7 +646,7 @@ def run_pod(
     if cfg is None and backend is None:
         cfg = ModelConfig()
     if backend is None:
-        backend = kubeapi_backend(cfg)
+        backend = kubeapi_backend(cfg, coverage=coverage)
     if cfg is None and meta_config is None:
         meta_config = {"backend": "custom"}
     sort_free = resolve_sort_free(sort_free, chunk)
@@ -619,7 +666,7 @@ def run_pod(
         fp_capacity=fp_capacity,
         devices=D,
         pipeline=False,
-        obs_slots=0,
+        obs_slots=obs_slots,
         sort_free=sort_free,
         deferred=deferred,
         symmetry=bool(red is not None and red.plan is not None),
@@ -672,8 +719,9 @@ def run_pod(
             cfg, mesh, chunk, queue_capacity, fp_capacity,
             fp_index=fp_index, seed=seed, route_factor=route_factor,
             backend=backend, fp_highwater=fp_highwater,
-            sort_free=sort_free, deferred=deferred, store=store,
-            on_event=lambda kind, info: emit(kind, **info),
+            obs_slots=obs_slots, sort_free=sort_free,
+            deferred=deferred, store=store,
+            on_event=lambda kind, info: emit(kind, host=host, **info),
         )
         template = rt.init_fn()
         seg = rt.segment_fn(ckpt_every)
@@ -682,7 +730,7 @@ def run_pod(
             cfg, mesh, chunk, queue_capacity, fp_capacity,
             fp_index=fp_index, seed=seed, route_factor=route_factor,
             segment=ckpt_every, backend=backend, sort_free=sort_free,
-            deferred=deferred,
+            deferred=deferred, obs_slots=obs_slots,
         )
         template = init_fn()
         if hosts > 1:
@@ -739,11 +787,38 @@ def run_pod(
                          fp_capacity=fp_capacity, devices=D,
                          hosts=hosts, route_factor=route_factor,
                          sort_free=sort_free, deferred=deferred,
-                         spill=spill_on))
+                         spill=spill_on, obs_slots=obs_slots,
+                         coverage=(getattr(backend, "coverage", None)
+                                   is not None)))
     emit("pod", phase="join", host=host, hosts=hosts)
 
     gather = make_stats_gather(mesh, carry)
     vote = make_stop_vote(mesh)
+
+    # per-host obs cursors: each fence decodes only THIS process's new
+    # ring rows / coverage movement (no extra collective - the fold
+    # back to pod-global totals happens in obs.views over the sibling
+    # journals).  fp_load is the host partial over the GLOBAL capacity
+    # so the fold can SUM loads.  On resume the cursors seed from the
+    # restored carry: journal and checkpoint are written at the same
+    # fence, so replaying from the snapshot appends exactly the rows
+    # the interrupted journal does not already hold.
+    cov_plane = getattr(backend, "coverage", None)
+    fp_total = fp_capacity * D
+    obs_since = 0
+    cov_seen = None
+    cov_visited = cov_level = cov_last_new_level = 0
+    cov_saturated = False
+    if resumed:
+        if obs_slots:
+            _, obs_since = obs_rows_sharded_local(carry, since=1 << 30)
+        if cov_plane is not None:
+            cov_seen = cov_totals_local(carry)
+            if cov_seen is not None:
+                cov_visited = int((cov_seen > 0).sum())
+        cov_level = cov_last_new_level = int(
+            np.asarray(_first_row(carry.level))
+        )
 
     def save_all(c, label="segment"):
         ts = time.time()
@@ -753,7 +828,7 @@ def run_pod(
 
             store.save(spill_sibling(path))
         emit("checkpoint", path=path, seconds=time.time() - ts,
-             label=label)
+             label=label, host=host)
         return path
 
     flag = _SigtermFlag()
@@ -766,11 +841,47 @@ def run_pod(
         while bool(np.asarray(_first_row(carry.cont))):
             if max_segments is not None and segments >= max_segments:
                 break
+            t_dispatch = time.time()
             carry = jax.block_until_ready(seg(carry))
+            t_fence = time.time()
             segments += 1
             tx = time.time()
             stop_now = vote(flag.hit)
             exchange_us = (time.time() - tx) * 1e6
+            # obs at EVERY fence (checkpoint cadence, NOT progress
+            # cadence): resume replays from the same fence the journal
+            # last recorded, so the cursors give exactly-once rows
+            emit("segment", index=segments - 1, host=host,
+                 t_dispatch=t_dispatch, t_fence=t_fence,
+                 wall_s=round(t_fence - t_dispatch, 6))
+            for row in segment_phases(segments - 1,
+                                      t_fence - t_dispatch):
+                emit("phase", host=host, **row)
+            if obs_slots:
+                rows, obs_since = obs_rows_sharded_local(
+                    carry, labels=backend.labels, since=obs_since,
+                    fp_capacity_total=fp_total)
+                for row in rows:
+                    emit("level", host=host, **row)
+                if rows:
+                    cov_level = max(cov_level, rows[-1]["level"])
+            if cov_plane is not None:
+                totals = cov_totals_local(carry)
+                payload = coverage_delta_event(
+                    cov_plane.sites, totals, cov_seen)
+                if payload is not None:
+                    emit("coverage", host=host, **payload)
+                    cov_seen = totals
+                    if payload["visited"] > cov_visited:
+                        cov_visited = payload["visited"]
+                        cov_last_new_level = cov_level
+                if (not cov_saturated and cov_visited
+                        and cov_level - cov_last_new_level
+                        >= COVERAGE_SAT_LEVELS):
+                    cov_saturated = True
+                    emit("coverage", host=host, visited=cov_visited,
+                         sites=len(cov_plane.sites), delta={},
+                         saturated=True, level=cov_level)
             if progress_every and segments % progress_every == 0:
                 st = gather(carry)
                 emit("progress", depth=int(st.depth.max()),
@@ -799,6 +910,7 @@ def run_pod(
         st, wall, iterations=segments, labels=backend.labels,
         viol_names=backend.viol_names,
         fp_capacity_total=fp_capacity * D,
+        sites=(cov_plane.sites if cov_plane is not None else None),
     )
     done = not bool(np.asarray(_first_row(carry.cont)))
     if preempted:

@@ -1054,10 +1054,15 @@ class ShardedSpillRuntime:
     The flush decision is a device-side collective (pmax over per-table
     occupancy), so every pod process takes the flush on the same chunk
     step - required, because resetting the global table is a collective
-    array construction.  When ANY device crosses the fp_highwater load,
-    EVERY host flushes all of its local device tables (eager for the
-    under-water ones, but deterministic and exact - the cold tier
-    absorbs everything, like engine.spill's whole-table flush).
+    array construction.  But the SWEEP is selective (ROADMAP #1 residue
+    (c) closed): each host migrates only its local tables that actually
+    crossed the highwater threshold, judged from the same occupancy
+    readback that fed the pmax - an under-water table keeps its hot
+    fingerprints resident instead of being eagerly dumped to the cold
+    tier.  Still deterministic and exact: the needy set is a pure
+    function of the collective step's occupancies, identical on every
+    process, and a fingerprint lives in its owner's table or its owner
+    host's store, never both.
 
     Exactness: a host-vetoed candidate dedups exactly like an owner-
     table hit, so counters/verdict are bit-for-bit a correctly-sized
@@ -1199,11 +1204,15 @@ class ShardedSpillRuntime:
 
     # -- the host-driven step loop --------------------------------------
 
-    def _flush(self, carry: ShardCarry) -> ShardCarry:
-        """Migrate every LOCAL device table into this host's store and
-        reset the global table (all processes flush on the same chunk
-        step - the residency verdict is a pmax).  Raises OSError through
-        spill_write_hook under fault injection."""
+    def _flush(self, carry: ShardCarry, needy=None) -> ShardCarry:
+        """Migrate this host's OVER-HIGHWATER device tables into the
+        store and reset their global rows (all processes flush on the
+        same chunk step - the residency verdict is a pmax; the
+        shard_replace_rows construction is collective either way).
+        `needy` is the set of local row ids to sweep (None = all, the
+        pre-highwater whole-table semantics adopt/recover paths use).
+        Raises OSError through spill_write_hook under fault
+        injection."""
         try:
             if self.spill_write_hook is not None:
                 self.spill_write_hook()
@@ -1213,12 +1222,18 @@ class ShardedSpillRuntime:
             raise SpillWriteError(str(e)) from e
         from .fpset import unmix_host
 
+        t_flush = time.time()
         rows = shard_host_rows(carry.table)
         zeroed = {}
+        resident = 0
         for d, t in rows.items():
             lo = t[:, 0::2].reshape(-1)
             hi = t[:, 1::2].reshape(-1)
             occ = (lo != 0) | (hi != 0)
+            if needy is not None and d not in needy:
+                # under-water table: its fingerprints stay resident
+                resident += int(occ.sum())
+                continue
             raw_lo, raw_hi = unmix_host(lo[occ], hi[occ])
             self.store.insert_batch(raw_lo, raw_hi)
             zeroed[d] = np.zeros_like(t)
@@ -1227,9 +1242,11 @@ class ShardedSpillRuntime:
             table=shard_replace_rows(carry.table, zeroed)
         )
         self._emit(
-            "spill", phase="flush", resident=0,
+            "spill", phase="flush", resident=resident,
             spilled=self.store.count, capacity=self.store.capacity,
             hits=self._hits(carry), probes=self.probes,
+            flushed_tables=len(zeroed),
+            wall_s=round(time.time() - t_flush, 6),
         )
         return carry
 
@@ -1259,14 +1276,25 @@ class ShardedSpillRuntime:
         and their pop sequence match the fused sharded body's, so
         bit-for-bit parity with a clean run holds."""
 
+        highwater_slots = int(self.fp_capacity * self.fp_highwater)
+
         def seg(carry):
             for _ in range(ckpt_every):
                 if not self._cont(carry):
                     break
-                _occ, need = self._res_fn(carry.table)
+                occ, need = self._res_fn(carry.table)
                 if max(int(v) for v in
                        shard_host_rows(need).values()):
-                    carry = self._flush(carry)
+                    # collective verdict (pmax) says SOME device crossed
+                    # highwater: every process enters the flush on this
+                    # step, but each sweeps only its local tables that
+                    # are actually over the threshold (same predicate
+                    # the device residency check evaluates)
+                    needy = {
+                        d for d, v in shard_host_rows(occ).items()
+                        if int(v) + self._DB > highwater_slots
+                    }
+                    carry = self._flush(carry, needy=needy)
                 ex = self._expand_fn(carry)
                 lo_rows = shard_host_rows(ex.r_lo)
                 hi_rows = shard_host_rows(ex.r_hi)
@@ -1361,6 +1389,50 @@ def obs_rows_sharded(carry: ShardCarry, labels: tuple = None,
             since=since, fp_capacity_total=fp_capacity_total,
         ),
         int(heads.min()),
+    )
+
+
+def obs_rows_sharded_local(carry: ShardCarry, labels: tuple = None,
+                           since: int = 0, fp_capacity_total: int = 0):
+    """Pod twin of obs_rows_sharded: decode only THIS process's
+    ADDRESSABLE ring rows into per-host PARTIAL `level` events (every
+    device flips levels in lock-step - the level fence is a global psum
+    - so summing the local subset per row yields this host's partial
+    cumulative counters for the same level sequence).  The obs.views
+    fold (fold_pod_levels) sums the per-host partials back into
+    pod-global rows.  `fp_capacity_total` should be the GLOBAL pod
+    capacity so each host's fp_load is its partial contribution and the
+    fold can SUM loads.  Returns (rows, new local-min head cursor);
+    ([], since) when obs is off."""
+    from ..obs.counters import shard_rows_from_ring
+
+    if getattr(carry, "obs_ring", None) is None:
+        return [], int(since)
+    rings = shard_host_rows(carry.obs_ring)
+    heads = shard_host_rows(carry.obs_head)
+    ids = sorted(rings)
+    local_ring = np.stack([np.asarray(rings[i]) for i in ids])
+    local_heads = np.asarray([int(heads[i]) for i in ids])
+    return (
+        shard_rows_from_ring(
+            local_ring, local_heads, labels=labels, since=since,
+            fp_capacity_total=fp_capacity_total,
+        ),
+        int(local_heads.min()),
+    )
+
+
+def cov_totals_local(carry: ShardCarry):
+    """This process's PARTIAL site-coverage totals: the int64 sum of
+    its addressable cov_counts rows (a site accrues counts on every
+    device that processes its candidates, so summing each host's
+    partial deltas across the pod reproduces the global totals).  None
+    when the carry has no coverage plane."""
+    if getattr(carry, "cov_counts", None) is None:
+        return None
+    rows = shard_host_rows(carry.cov_counts)
+    return np.sum(
+        [np.asarray(v, np.int64) for v in rows.values()], axis=0
     )
 
 

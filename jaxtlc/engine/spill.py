@@ -375,6 +375,9 @@ class SpillRuntime:
                 self.spill_write_hook()
         except OSError as e:
             raise SpillWriteError(str(e)) from e
+        import time
+
+        t_flush = time.time()
         table = np.asarray(carry.fps.table)
         lo = table[:, 0::2].reshape(-1)
         hi = table[:, 1::2].reshape(-1)
@@ -387,6 +390,7 @@ class SpillRuntime:
             "spill", phase="flush", resident=0,
             spilled=self.store.count, capacity=self.store.capacity,
             hits=int(carry.spill_hits), probes=self.probes,
+            wall_s=round(time.time() - t_flush, 6),
         )
         return carry
 

@@ -132,24 +132,38 @@ def coverage_from_events(events) -> Optional[dict]:
     """Fold a journal's `coverage` delta events back into cumulative
     totals - the derived view obs.serve's ``GET /coverage``, the
     Prometheus ``coverage_site_total`` counters, tlcstat and covdiff
-    all render.  None when the run carried no coverage plane."""
+    all render.  None when the run carried no coverage plane.
+
+    Pod-aware (ISSUE 20): merged ``{base}.hN`` sibling journals carry
+    per-host PARTIAL deltas (disjoint fingerprint shards, so the sum
+    of partials IS the global total) whose `visited` headers describe
+    only that host's rows - so `visited` recomputes from the folded
+    totals instead of trusting any single header, and the pod counts
+    as saturated only when EVERY host that emitted coverage carried
+    its once-per-run saturation event (the level reported is the max)."""
     totals: Dict[str, int] = {}
-    visited = n_sites = 0
-    saturated_at = None
+    n_sites = 0
+    sat: Dict = {}  # host key (None = single journal) -> sat level
+    covered = set()
     for ev in events:
         if ev.get("event") != "coverage":
             continue
+        hk = ev.get("host")
+        covered.add(hk)
         for k, d in ev.get("delta", {}).items():
             totals[k] = totals.get(k, 0) + int(d)
-        visited = ev.get("visited", visited)
         n_sites = ev.get("sites", n_sites)
         if ev.get("saturated"):
-            saturated_at = ev.get("level")
+            sat[hk] = ev.get("level")
     if not totals and n_sites == 0:
         return None
+    saturated_at = None
+    if covered and covered <= set(sat):
+        levels = [v for v in sat.values() if v is not None]
+        saturated_at = max(levels) if levels else None
     return {
         "sites": totals,
-        "visited": visited or sum(1 for v in totals.values() if v),
+        "visited": sum(1 for v in totals.values() if v),
         "n_sites": n_sites or len(totals),
         "saturated_at_level": saturated_at,
     }

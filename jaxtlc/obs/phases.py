@@ -13,7 +13,9 @@ in three capture modes of increasing resolution and cost:
    readback/checkpoint walls it already measures into schema-validated
    `phase` journal events (scope="segment").  Zero device work, zero
    extra syncs - pure host arithmetic, which is why the `--obs-ab`
-   harness gates its overhead at <= 0.5%.
+   harness gates its overhead at <= 0.5%.  The pod driver
+   (jaxtlc.dist, ISSUE 20) emits the same rows per host with a `host`
+   field, so a merged pod journal's phase walls attribute per process.
 2. **`-phase-timing`** (PhasedRuntime): the supervisor swaps its fused
    segment dispatch for a host-fenced step loop whose expand and commit
    halves are SEPARATELY jitted from the very `make_stage_pair` closures

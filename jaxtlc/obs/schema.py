@@ -44,7 +44,12 @@ EVENTS = {
     # one supervised segment fenced: host-observed dispatch/fence times
     "segment": {"index": _NUM, "t_dispatch": _NUM, "t_fence": _NUM,
                 "wall_s": _NUM},
-    # one BFS level completed (decoded from the device counter ring)
+    # one BFS level completed (decoded from the device counter ring).
+    # Pod journals (jaxtlc.dist, ISSUE 20) tag these with an extra
+    # `host` field and PARTIAL counters - each host decodes its own
+    # ring, and obs.views.fold_pod_levels sums the {base}.hN siblings
+    # back to pod-global rows (last row per (host, level) wins: the
+    # ring re-records the final level on empty-queue trailing steps)
     "level": {"level": _NUM, "generated": _NUM, "distinct": _NUM,
               "queue": _NUM, "bodies": _NUM, "expanded": _NUM},
     # the TLC 2200 Progress-line source (segment-boundary counters)
@@ -89,7 +94,10 @@ EVENTS = {
     # visit DELTAS since the previous event (cumulative totals are the
     # fold of all deltas - obs.coverage.coverage_from_events), plus the
     # visited-site header.  An event with saturated=true (extra field)
-    # is the "no new site for N levels" signal.
+    # is the "no new site for N levels" signal.  Pod journals carry a
+    # `host` field with per-host partial deltas; coverage_from_events
+    # folds siblings into one summed site table (visited/saturation
+    # recomputed from the folded totals)
     "coverage": {"visited": _NUM, "sites": _NUM, "delta": (dict,)},
     # -- phase attribution (obs.phases) ------------------------------------
     # one measured wall per (scope, index, phase): scope "segment" rows

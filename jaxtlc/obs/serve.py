@@ -83,6 +83,14 @@ def _run_row(p: str) -> Optional[dict]:
     fin = next(
         (e for e in reversed(events) if e["event"] == "final"), None
     )
+    # coverage saturation (ISSUE 20 satellite): the journal's
+    # once-per-run saturation event (PR 11), surfaced on the registry
+    # row so /runs answers "did coverage plateau" without a re-read -
+    # `coverage` marks journals that carry the plane at all, so a pod
+    # row can distinguish "no plane" from "not yet saturated"
+    cov_evs = [e for e in events if e["event"] == "coverage"]
+    sat = next((e for e in reversed(cov_evs) if e.get("saturated")),
+               None)
     row = {
         "run": os.path.basename(p)[: -len(JOURNAL_SUFFIX)]
         if p.endswith(JOURNAL_SUFFIX) else os.path.basename(p),
@@ -95,6 +103,10 @@ def _run_row(p: str) -> Optional[dict]:
         "resumes": sum(
             1 for e in events if e["event"] == "run_resume"
         ),
+        "coverage": bool(cov_evs),
+        "coverage_saturated": sat is not None,
+        "coverage_saturated_level": (sat.get("level")
+                                     if sat is not None else None),
     }
     with _RUNS_CACHE_LOCK:
         _RUNS_CACHE[p] = (key, row)
@@ -128,6 +140,13 @@ def _group_pod_rows(rows: List[dict]) -> List[dict]:
     for base, members in pods.items():
         members.sort()
         hrows = [r for _, r in members]
+        # pod saturation: every host's coverage is a disjoint
+        # fingerprint shard, so the POD has plateaued only when EVERY
+        # covered host carried its once-per-run saturation event; the
+        # level reported is the last (max) host level to plateau
+        covered = [r for r in hrows if r.get("coverage")]
+        saturated = bool(covered) and all(
+            r.get("coverage_saturated") for r in covered)
         out.append({
             "run": base,
             "path": hrows[0]["path"],
@@ -140,6 +159,12 @@ def _group_pod_rows(rows: List[dict]) -> List[dict]:
                            key=lambda v: _VERDICT_RANK.get(v, 4)),
             "last_t": max((r["last_t"] or 0 for r in hrows)) or None,
             "resumes": sum(r["resumes"] for r in hrows),
+            "coverage": bool(covered),
+            "coverage_saturated": saturated,
+            "coverage_saturated_level": (max(
+                (r.get("coverage_saturated_level") or 0
+                 for r in covered), default=0) or None
+                if saturated else None),
         })
     return out
 
@@ -199,6 +224,19 @@ def prometheus_text(metrics: dict) -> str:
                     f'jaxtlc_phase_wall_seconds{{phase="{phase}"}} '
                     f"{secs}"
                 )
+            continue
+        if key == "pod_host_rates":
+            # per-host per-level rates (ISSUE 20): the same figures as
+            # jaxtlc_states_per_second, computed from each host's RAW
+            # partial level rows - so a scrape sees the pod rate both
+            # without (folded) and with host labels
+            lines.append("# HELP jaxtlc_host_states_per_second "
+                         "per-host per-level state rates")
+            for host, gauges in sorted(val.items()):
+                for gk, gv in sorted(gauges.items()):
+                    lines.append(
+                        f'jaxtlc_host_{gk}{{host="{host}"}} {gv}'
+                    )
             continue
         if key == "pod_hosts":
             # per-host pod gauges (jaxtlc.dist): shard-table load,

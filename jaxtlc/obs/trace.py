@@ -26,6 +26,17 @@ it can be produced live (`-trace-out`), after the fact from any
 journal file (`python -m jaxtlc.obs.trace run.journal.jsonl`), or
 across an interruption - a SIGTERM'd + `-recover`ed run's single
 continuous journal renders as one timeline with the gap visible.
+
+Pod runs (ISSUE 20): a merged ``{base}.hN`` sibling stream renders as
+ONE trace with a process-row PAIR per host (device lanes + host lanes,
+keyed by the events' ``host`` field).  Every host's segment slices
+share the same time origin, so cross-host skew is the horizontal
+offset between the rows' fence edges, and the all_to_all fence wait is
+the gap a fast host's segment end leaves before the slow host's - the
+distributed-timeline reading the TensorFlow timeline discipline
+(arXiv:1605.08695 §5) is built for.  Spill flushes carry their
+measured wall (the highwater-triggered sweep) and render as duration
+slices on their host's row.
 """
 
 from __future__ import annotations
@@ -36,6 +47,7 @@ from typing import List
 
 PID_DEVICE = 1
 PID_HOST = 2
+POD_PID_BASE = 10  # host h -> pids (BASE + 2h, BASE + 2h + 1)
 TID_SEGMENT = 1
 TID_EXPAND = 2
 TID_COMMIT = 3
@@ -67,41 +79,68 @@ def chrome_trace_events(events: List[dict]) -> List[dict]:
             pipeline = bool(ev.get("params", {}).get("pipeline"))
             break
 
-    out = [
-        _meta(PID_DEVICE, "device engine"),
-        _meta(PID_HOST, "host (checkpoint/regrow)"),
-        _thread(PID_DEVICE, TID_SEGMENT, "segments"),
-        _thread(PID_DEVICE, TID_EXPAND, "expand (per level, schematic)"),
-        _thread(PID_DEVICE, TID_COMMIT, "commit (per level, schematic)"),
-        _thread(PID_HOST, TID_CKPT, "checkpoint writes"),
-        _thread(PID_HOST, TID_REGROW, "regrow migrations"),
-    ]
+    out = []
+    known: set = set()
 
-    def instant(ev, name, args=None):
+    def pid_device(h):
+        return PID_DEVICE if h is None else POD_PID_BASE + 2 * h
+
+    def pid_host(h):
+        return PID_HOST if h is None else POD_PID_BASE + 2 * h + 1
+
+    def ensure(h):
+        """Emit the process/thread metadata rows for host key `h` once
+        (None = the single-process row pair; pod hosts each get their
+        own pair, so the merged journal renders one process row per
+        host with identical lane structure)."""
+        if h in known:
+            return
+        known.add(h)
+        tag = "" if h is None else f" host {h}"
+        out.extend([
+            _meta(pid_device(h), f"device engine{tag}"),
+            _meta(pid_host(h), f"host (checkpoint/regrow){tag}"),
+            _thread(pid_device(h), TID_SEGMENT, "segments"),
+            _thread(pid_device(h), TID_EXPAND,
+                    "expand (per level, schematic)"),
+            _thread(pid_device(h), TID_COMMIT,
+                    "commit (per level, schematic)"),
+            _thread(pid_host(h), TID_CKPT, "checkpoint writes"),
+            _thread(pid_host(h), TID_REGROW, "regrow migrations"),
+        ])
+
+    ensure(None)
+
+    def instant(ev, name, args=None, h=None):
+        ensure(h)
         out.append({"name": name, "ph": "i", "s": "g",
-                    "ts": us(ev["t"]), "pid": PID_HOST, "tid": TID_CKPT,
-                    "args": args or {}})
+                    "ts": us(ev["t"]), "pid": pid_host(h),
+                    "tid": TID_CKPT, "args": args or {}})
 
     # level events journal at the fence AFTER the segment they ran in:
     # walk in order, buffering levels (and any measured per-level phase
-    # walls) against the most recent segment
-    pending_levels: List[dict] = []
-    pending_phases: dict = {}  # level -> {"expand": s, "commit": s}
-    last_segment = None
+    # walls) against the most recent segment - PER HOST KEY, so a
+    # merged pod stream's interleaved hosts never cross-attribute
+    pending_levels: dict = {}  # host key -> [level rows]
+    pending_phases: dict = {}  # host key -> {level: {expand, commit}}
+    last_segment: dict = {}  # host key -> segment event
+    prev_level: dict = {}  # host key -> last level event
 
-    def flush_levels():
-        """Subdivide the last segment's wall among its buffered levels,
-        emitting expand/commit sub-slices whose overlap mirrors the
-        engine's step schedule.  MEASURED placement when the segment's
-        `phase` events cover every buffered level (a -phase-timing run:
-        sequential expand->commit slices of the measured walls);
-        body-count-proportional schematic otherwise."""
-        nonlocal pending_levels, pending_phases
-        seg, levels, phases = last_segment, pending_levels, pending_phases
-        pending_levels = []
-        pending_phases = {}
+    def flush_levels(h):
+        """Subdivide host `h`'s last segment wall among its buffered
+        levels, emitting expand/commit sub-slices whose overlap mirrors
+        the engine's step schedule.  MEASURED placement when the
+        segment's `phase` events cover every buffered level (a
+        -phase-timing run: sequential expand->commit slices of the
+        measured walls); body-count-proportional schematic otherwise."""
+        seg = last_segment.get(h)
+        levels = pending_levels.pop(h, [])
+        phases = pending_phases.pop(h, {})
         if seg is None or not levels:
             return
+        # shadow the module pids with this host's row pair: the slice
+        # emission below then lands on the right process row unchanged
+        PID_DEVICE = pid_device(h)
         seg_ts = us(seg["t_dispatch"])
         seg_dur = max(seg["wall_s"] * 1e6, 1.0)
         measured = all(
@@ -176,48 +215,57 @@ def chrome_trace_events(events: List[dict]) -> List[dict]:
                             "args": {"load": lv["fp_load"]}})
             cursor += dur
 
-    prev_level = None
     for ev in events:
         kind = ev["event"]
+        h = ev.get("host") if kind in (
+            "segment", "level", "phase", "checkpoint", "spill") else None
         if kind == "segment":
-            flush_levels()
-            last_segment = ev
+            ensure(h)
+            flush_levels(h)
+            last_segment[h] = ev
             out.append({
                 "name": f"segment {ev['index']}", "ph": "X",
                 "ts": us(ev["t_dispatch"]),
                 "dur": max(ev["wall_s"] * 1e6, 1.0),
-                "pid": PID_DEVICE, "tid": TID_SEGMENT,
+                "pid": pid_device(h), "tid": TID_SEGMENT,
                 "args": {"index": ev["index"],
                          "wall_s": ev["wall_s"]},
             })
         elif kind == "level":
+            prev = prev_level.get(h)
+            if prev is not None and prev["level"] == ev["level"]:
+                # empty-queue trailing flips re-record the final
+                # level's (identical, cumulative) row each no-op step
+                continue
             lv = dict(ev)
             # per-level body count from the cumulative counter
             lv["bodies_level"] = (
-                ev["bodies"] - prev_level["bodies"]
-                if prev_level is not None else ev["bodies"]
+                ev["bodies"] - prev["bodies"]
+                if prev is not None else ev["bodies"]
             )
-            prev_level = ev
-            pending_levels.append(lv)
+            prev_level[h] = ev
+            pending_levels.setdefault(h, []).append(lv)
         elif kind == "phase":
             if ev["scope"] == "level":
-                pending_phases.setdefault(ev["index"], {})[
-                    ev["phase"]
-                ] = ev["wall_s"]
+                pending_phases.setdefault(h, {}).setdefault(
+                    ev["index"], {}
+                )[ev["phase"]] = ev["wall_s"]
             elif ev["scope"] == "segment" and ev["phase"] == "readback":
+                ensure(h)
                 out.append({
                     "name": "readback", "ph": "X",
                     "ts": us(ev["t"] - ev["wall_s"]),
                     "dur": max(ev["wall_s"] * 1e6, 1.0),
-                    "pid": PID_HOST, "tid": TID_CKPT,
+                    "pid": pid_host(h), "tid": TID_CKPT,
                     "args": {"segment": ev["index"]},
                 })
         elif kind == "checkpoint":
+            ensure(h)
             out.append({
                 "name": f"checkpoint ({ev['label']})", "ph": "X",
                 "ts": us(ev["t"] - ev["seconds"]),
                 "dur": max(ev["seconds"] * 1e6, 1.0),
-                "pid": PID_HOST, "tid": TID_CKPT,
+                "pid": pid_host(h), "tid": TID_CKPT,
                 "args": {"path": ev["path"]},
             })
         elif kind == "regrow":
@@ -237,12 +285,28 @@ def chrome_trace_events(events: List[dict]) -> List[dict]:
         elif kind == "spill":
             # the host tier's lifecycle rides the regrow thread (both
             # are host-side capacity work); also a counter track so
-            # Perfetto graphs the cold-tier growth
-            instant(ev, f"spill {ev['phase']}",
-                    {"spilled": ev["spilled"], "hits": ev.get("hits"),
-                     "probes": ev.get("probes")})
+            # Perfetto graphs the cold-tier growth.  Highwater flushes
+            # carry their measured wall (ISSUE 20) and render as
+            # DURATION slices, so the timeline shows what the sweep
+            # cost at the fence that paid it
+            ensure(h)
+            if ev.get("phase") == "flush" and ev.get("wall_s"):
+                out.append({
+                    "name": "spill flush", "ph": "X",
+                    "ts": us(ev["t"] - ev["wall_s"]),
+                    "dur": max(ev["wall_s"] * 1e6, 1.0),
+                    "pid": pid_host(h), "tid": TID_REGROW,
+                    "args": {"spilled": ev["spilled"],
+                             "flushed_tables": ev.get("flushed_tables"),
+                             "wall_s": ev["wall_s"]},
+                })
+            else:
+                instant(ev, f"spill {ev['phase']}",
+                        {"spilled": ev["spilled"],
+                         "hits": ev.get("hits"),
+                         "probes": ev.get("probes")}, h=h)
             out.append({"name": "spilled_fps", "ph": "C",
-                        "ts": us(ev["t"]), "pid": PID_HOST, "tid": 0,
+                        "ts": us(ev["t"]), "pid": pid_host(h), "tid": 0,
                         "args": {"spilled": ev["spilled"]}})
         elif kind == "degrade":
             instant(ev, f"degrade [{ev['rung']}] {ev['resource']}",
@@ -261,7 +325,8 @@ def chrome_trace_events(events: List[dict]) -> List[dict]:
                     {"generated": ev["generated"],
                      "distinct": ev["distinct"],
                      "wall_s": ev["wall_s"]})
-    flush_levels()
+    for h in list(pending_levels):
+        flush_levels(h)
     return out
 
 
@@ -322,7 +387,8 @@ def _tiny_journal(path: str) -> None:
         j.event("spill", phase="activate", resident=240, spilled=0,
                 capacity=1 << 12, hits=0, probes=0)
         j.event("spill", phase="flush", resident=0, spilled=240,
-                capacity=1 << 12, hits=12, probes=60)
+                capacity=1 << 12, hits=12, probes=60, wall_s=0.003,
+                flushed_tables=1)
         j.event("retry", attempt=1, delay_s=0.01, error="injected")
         j.event("interrupted", signum=15, path=None, generated=400,
                 distinct=240, queue=30, wall_s=0.2)

@@ -38,6 +38,84 @@ def merge_journals(*streams):
     return list(heapq.merge(*streams, key=lambda e: e.get("t", 0)))
 
 
+def pod_sibling_journals(path):
+    """All ``{base}.hN.journal.jsonl`` siblings of `path` on disk
+    (host-ordered), or ``[path]`` when it is not a per-host pod
+    journal - so a CLI pointed at ANY one host's journal (tlcstat,
+    covdiff) can render the whole pod merged."""
+    import os
+    import re
+
+    m = re.match(r"^(?P<base>.+)\.h\d+\.journal\.jsonl$",
+                 os.path.basename(path))
+    if not m:
+        return [path]
+    d = os.path.dirname(os.path.abspath(path))
+    pat = re.compile(re.escape(m.group("base"))
+                     + r"\.h(\d+)\.journal\.jsonl$")
+    out = {}
+    for name in os.listdir(d):
+        mm = pat.fullmatch(name)
+        if mm:
+            out[int(mm.group(1))] = os.path.join(d, name)
+    return [out[k] for k in sorted(out)] or [path]
+
+
+def fold_pod_levels(events):
+    """Fold per-host PARTIAL ``level`` rows (jaxtlc.dist pods tag each
+    with a ``host`` field, decoded from that process's ring rows only)
+    into pod-global per-level rows: devices flip levels in lock-step
+    (the level fence is a global psum), so the rows of every host at
+    one level describe the SAME level with per-host partial cumulative
+    counters - sum them, exactly shard_rows_from_ring's arithmetic
+    lifted to the journal tier.  fp_load sums too (each host's load is
+    its partial over the GLOBAL pod capacity); sticky flags OR; the
+    action dicts add; `t` keeps the latest host stamp.  Journals with
+    no host-tagged level rows pass through unchanged, so every
+    single-process surface is untouched.
+
+    Each host contributes AT MOST ONE row per level: the ring flips
+    once per chunk step while the queue stays empty, so the final
+    segment of a finished run re-records the last level's row on every
+    no-op step - cumulative counters make those rows identical, and
+    the LAST one per (host, level) is the authoritative partial."""
+    host_levels = [e for e in events
+                   if e.get("event") == "level" and "host" in e]
+    if not host_levels:
+        return events
+    last: dict = {}  # (host, level) -> the host's final row for it
+    for e in host_levels:
+        last[(e["host"], int(e["level"]))] = e
+    by_level: dict = {}
+    for (_h, lv), e in sorted(last.items(),
+                              key=lambda kv: (kv[0][1], kv[0][0])):
+        g = by_level.setdefault(lv, {
+            "event": "level", "t": e.get("t", 0), "level": lv,
+            "generated": 0, "distinct": 0, "queue": 0,
+            "bodies": 0, "expanded": 0,
+        })
+        g["t"] = max(g["t"], e.get("t", 0))
+        for k in ("generated", "distinct", "queue", "bodies",
+                  "expanded", "spill_hits"):
+            if k in e:
+                g[k] = g.get(k, 0) + int(e[k])
+        if "fp_load" in e:
+            g["fp_load"] = round(g.get("fp_load", 0.0)
+                                 + float(e["fp_load"]), 6)
+        for k in ("counter_overflow", "cert_violation", "sym_violation"):
+            if e.get(k):
+                g[k] = True
+        for k in ("action_generated", "action_distinct"):
+            if k in e:
+                d = g.setdefault(k, {})
+                for a, v in e[k].items():
+                    d[a] = d.get(a, 0) + int(v)
+    rest = [e for e in events
+            if not (e.get("event") == "level" and "host" in e)]
+    return sorted(rest + list(by_level.values()),
+                  key=lambda e: e.get("t", 0))
+
+
 def pod_host_gauges(events) -> Optional[dict]:
     """The per-host gauge table from a (merged) journal's ``pod``
     events: {host: {shard_occupancy, spill_bytes, exchange_us}}, each
@@ -100,7 +178,15 @@ def metrics_from_events(events) -> dict:
     """The run-monitoring metric set (obs.serve /metrics) as one flat
     dict, derived from a journal event list by the SAME arithmetic the
     TLC 2200 line and tlcstat use (interval_rates / eta_s above), so a
-    Prometheus scrape can never disagree with the transcript."""
+    Prometheus scrape can never disagree with the transcript.
+
+    Pod journals (merged ``{base}.hN`` siblings) fold first: the
+    headline counters/rates come from the pod-global per-level rows
+    (fold_pod_levels), and the RAW per-host rows additionally yield
+    `pod_host_rates` so Prometheus can export per-level rates both
+    with and without host labels."""
+    raw = events
+    events = fold_pod_levels(events)
     prog = [e for e in events
             if e["event"] in ("level", "progress", "final",
                               "interrupted", "exhausted", "recovery")]
@@ -226,6 +312,28 @@ def metrics_from_events(events) -> dict:
         hosts = pod_host_gauges(pod_evs)
         if hosts:
             out["pod_hosts"] = hosts
+    host_levels: dict = {}
+    for e in raw:
+        if e.get("event") == "level" and "host" in e:
+            host_levels.setdefault(int(e["host"]), []).append(e)
+    if host_levels:
+        # per-host per-level rates from each host's RAW partial rows
+        # (Prometheus jaxtlc_host_states_per_second{host=...}); the
+        # unlabeled rates above come from the folded pod-global rows
+        rates = {}
+        for h, lv in sorted(host_levels.items()):
+            if len(lv) > 1:
+                p, c = lv[-2], lv[-1]
+                spm, dpm = interval_rates(
+                    (p["t"], p["generated"], p["distinct"]),
+                    c["t"], c["generated"], c["distinct"],
+                )
+                rates[h] = {
+                    "states_per_second": round(spm / 60.0, 3),
+                    "distinct_per_second": round(dpm / 60.0, 3),
+                }
+        if rates:
+            out["pod_host_rates"] = rates
     sp = next((e for e in reversed(events) if e["event"] == "spill"),
               None)
     if sp is not None:

@@ -541,8 +541,8 @@ def test_selfcheck_registry_pinned():
     from jaxtlc.analysis.selfcheck import FACTORIES
 
     assert sorted(FACTORIES) == [
-        "covered", "deferred", "enumerator", "fused", "infer",
-        "narrowed", "phased", "pipelined", "por", "sharded",
+        "covered", "covsharded", "deferred", "enumerator", "fused",
+        "infer", "narrowed", "phased", "pipelined", "por", "sharded",
         "shardspill", "sim", "sortfree", "spill", "struct", "sweep",
         "symmetry",
     ]

@@ -276,22 +276,35 @@ def test_regrow_migrates_coverage_verbatim(ff_plane):
         np.asarray(carry.cov_counts).sum())
 
 
-def test_sharded_2dev_psum_parity(ff_device_run):
-    """2-device mesh: per-device coverage partials sum to exactly the
-    single-device table (the psum-merge contract)."""
-    import jax
-    from jax.sharding import Mesh
+def test_pod_2dev_obs_coverage_parity(ff_device_run, tmp_path):
+    """2-device loopback pod: per-host coverage partials psum to
+    exactly the single-device table, the per-host counter-ring rows
+    fold to the engine totals, and the merged journal's site table is
+    the run's site table (ISSUE 20 pod parity at FF scale; this is
+    the old check_sharded psum-parity test routed through run_pod so
+    the obs plane rides the same single compile)."""
+    from jaxtlc.dist.pod import host_journal_path, run_pod
+    from jaxtlc.obs.journal import read as read_pod_journal
+    from jaxtlc.obs.views import fold_pod_levels
 
-    from jaxtlc.engine.sharded import check_sharded
-
-    mesh = Mesh(np.array(jax.devices()[:2]), ("fp",))
-    rs = check_sharded(
-        FF, mesh, chunk=128, queue_capacity=1 << 12,
-        fp_capacity=1 << 14,
-        backend=kubeapi_backend(FF, coverage=True),
+    base = str(tmp_path / "ff.ckpt")
+    pr = run_pod(
+        FF, chunk=128, queue_capacity=1 << 12, fp_capacity=1 << 14,
+        coverage=True, obs_slots=128, ckpt_path=base, ckpt_every=64,
+        devices=2,
     )
+    rs = pr.result
     assert (rs.generated, rs.distinct, rs.depth) == FF_EXPECT
     assert rs.site_coverage == ff_device_run.site_coverage
+    events = read_pod_journal(host_journal_path(base, 0))
+    levels = fold_pod_levels([e for e in events if e["event"] == "level"])
+    assert len(levels) == FF_EXPECT[2]
+    assert (levels[-1]["generated"], levels[-1]["distinct"]) == FF_EXPECT[:2]
+    folded = coverage_from_events(events)
+    assert folded["visited"] == sum(
+        1 for v in rs.site_coverage.values() if v)
+    for k, v in folded["sites"].items():
+        assert v == rs.site_coverage[k], k
 
 
 def test_checkpoint_meta_records_coverage(tmp_path):

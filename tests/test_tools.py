@@ -57,11 +57,13 @@ def test_profile_v4_tiny_smoke(capsys):
 
 def test_covdiff_tiny_smoke(capsys):
     """tools/covdiff.py --tiny: regression detection + JSON-artifact
-    round-trip on synthetic coverage tables (no engine run)."""
+    round-trip + {base}.hN pod-journal merge on synthetic coverage
+    tables (no engine run)."""
     mod = _load_tool("covdiff")
     assert mod.main(["--tiny"]) == 0
     out = capsys.readouterr().out
-    assert "covdiff tiny OK" in out
+    assert ("covdiff tiny OK: regression detection + artifact "
+            "round-trip + pod-journal merge") in out
 
 
 def test_tlcstat_tiny_smoke(capsys):

@@ -13,7 +13,10 @@ fatal unless --exact).
 
 Accepted formats for either side (sniffed by content):
   * a run journal (*.jsonl) - the `coverage` delta events fold into
-    cumulative totals (obs.coverage.coverage_from_events);
+    cumulative totals (obs.coverage.coverage_from_events); a per-host
+    POD journal ({base}.hN.journal.jsonl, jaxtlc.dist) pulls in every
+    sibling on disk and folds the merged stream, so the diff runs
+    against the pod-global summed site table;
   * a JSON artifact {"sites": {key: count, ...}} (GET /coverage body,
     or a previously saved covdiff --save);
   * a committed TLC MC.out - the coverage section's span lines are
@@ -75,14 +78,23 @@ def load_sites(path: str) -> Optional[Dict[str, int]]:
         # journal (one JSON object per line) vs artifact (one object)
         try:
             obj = json.load(open(path, "r", encoding="utf-8"))
-            if isinstance(obj, dict) and "sites" in obj:
+            # artifact {"sites": {key: count}} - NOT a one-line journal
+            # whose coverage event carries the integer `sites` header
+            if isinstance(obj, dict) and isinstance(
+                    obj.get("sites"), dict):
                 return {k: int(v) for k, v in obj["sites"].items()}
         except json.JSONDecodeError:
             pass
         from jaxtlc.obs import journal as jr
         from jaxtlc.obs.coverage import coverage_from_events
+        from jaxtlc.obs.views import merge_journals, pod_sibling_journals
 
-        cov = coverage_from_events(jr.read(path, validate=False))
+        paths = pod_sibling_journals(path)
+        events = (jr.read(paths[0], validate=False)
+                  if len(paths) == 1 else
+                  merge_journals(*(jr.read(p, validate=False)
+                                   for p in paths)))
+        cov = coverage_from_events(events)
         return cov["sites"] if cov else None
     return None
 
@@ -123,7 +135,24 @@ def _tiny() -> int:
         p = os.path.join(td, "cov.json")
         json.dump({"sites": base}, open(p, "w"))
         assert load_sites(p) == base
-    print("covdiff tiny OK: regression detection + artifact round-trip")
+        # pod journals: two synthetic per-host siblings must load as
+        # ONE summed site table from either host's path (the merged
+        # pod stream; partial deltas over disjoint shards add)
+        from jaxtlc.obs.journal import RunJournal
+
+        h0 = os.path.join(td, "pod.ckpt.h0.journal.jsonl")
+        h1 = os.path.join(td, "pod.ckpt.h1.journal.jsonl")
+        with RunJournal(h0) as j:
+            j.event("coverage", host=0, visited=2, sites=3,
+                    delta={"A": 7, "B": 1})
+        with RunJournal(h1) as j:
+            j.event("coverage", host=1, visited=1, sites=3,
+                    delta={"A": 3, "C": 2})
+        want = {"A": 10, "B": 1, "C": 2}
+        assert load_sites(h0) == want, load_sites(h0)
+        assert load_sites(h1) == want, load_sites(h1)
+    print("covdiff tiny OK: regression detection + artifact "
+          "round-trip + pod-journal merge")
     return 0
 
 

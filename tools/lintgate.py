@@ -26,8 +26,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # tests/test_analysis.py::test_selfcheck_registry_pinned); importing
 # the registry is jax-free, so this stays an engine-free gate
 REQUIRED_FACTORIES = (
-    "covered", "deferred", "enumerator", "fused", "infer",
-    "narrowed", "phased", "pipelined", "por", "sharded",
+    "covered", "covsharded", "deferred", "enumerator", "fused",
+    "infer", "narrowed", "phased", "pipelined", "por", "sharded",
     "shardspill", "sim", "sortfree", "spill", "struct", "sweep",
     "symmetry",
 )

@@ -58,7 +58,14 @@ def _last_two(events, kinds):
 
 
 def render(events) -> str:
-    """One dashboard frame from a journal event list."""
+    """One dashboard frame from a journal event list.  A merged pod
+    stream folds its per-host partial `level` rows into pod-global
+    rows first (obs.views.fold_pod_levels), so the headline counters
+    and rates describe the whole pod; the pod line below keeps the
+    per-host view (shard load, fence wait)."""
+    from jaxtlc.obs.views import fold_pod_levels
+
+    events = fold_pod_levels(events)
     if not events:
         return "tlcstat: journal is empty (run not started yet?)"
     manifest = next(
@@ -127,8 +134,12 @@ def render(events) -> str:
     pod = pod_host_gauges(events)
     if pod is not None:
         hosts = max(e["hosts"] for e in events if e["event"] == "pod")
+        # per-host fence-wait column: every host reports its OWN vote/
+        # exchange wall, so the skewed host is visible by name (the
+        # global fence waits for the slowest one, reported last)
         per = "  ".join(
-            f"h{h} shard {g['shard_occupancy']:.1%}"
+            f"h{h} shard {g['shard_occupancy']:.1%} "
+            f"fence {g['exchange_us'] / 1000:.1f}ms"
             + (f" spill {g['spill_bytes'] / 1024:.0f}KiB"
                if g["spill_bytes"] else "")
             for h, g in sorted(pod.items())
@@ -137,7 +148,7 @@ def render(events) -> str:
         reshards = sum(1 for e in events if e["event"] == "pod"
                        and e.get("phase") == "reshard")
         lines.append(
-            f"pod: {hosts} hosts  |  {per}  |  fence "
+            f"pod: {hosts} hosts  |  {per}  |  slowest fence "
             f"{fence / 1000:.1f}ms"
             + (f"  |  reshards {reshards}" if reshards else "")
         )
@@ -262,6 +273,18 @@ def render(events) -> str:
     return "\n".join([bar, *lines, bar])
 
 
+def _read_maybe_pod(path: str) -> list:
+    """Journal events; a per-host pod journal (``{base}.hN``) pulls in
+    every sibling on disk and k-way merges them, so pointing tlcstat at
+    ANY one host renders the whole pod's dashboard."""
+    from jaxtlc.obs.views import merge_journals, pod_sibling_journals
+
+    paths = pod_sibling_journals(path)
+    if len(paths) == 1:
+        return jr.read(paths[0], validate=False)
+    return merge_journals(*(jr.read(p, validate=False) for p in paths))
+
+
 def _fetch_remote(url: str, run: str = "") -> list:
     """Journal events from a jaxtlc.obs.serve monitor's /journal
     endpoint (the remote-client mode of the same dashboard)."""
@@ -333,11 +356,11 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     if not args.follow:
-        print(render(jr.read(args.journal, validate=False)))
+        print(render(_read_maybe_pod(args.journal)))
         return 0
     try:
         while True:
-            frame = render(jr.read(args.journal, validate=False))
+            frame = render(_read_maybe_pod(args.journal))
             sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
             sys.stdout.flush()
             time.sleep(args.interval)
